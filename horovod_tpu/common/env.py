@@ -1,0 +1,140 @@
+"""Centralized environment-variable configuration knobs.
+
+TPU-native analog of the reference's env plane: knob names are centralized in
+``horovod/common/common.h:64-90`` and parsed in ``BackgroundThreadLoop``
+(``horovod/common/operations.cc:416-513``) and ``common/utils/env_parser.cc``.
+
+We keep the ``HOROVOD_`` prefix for the knobs that have direct parity meaning so a
+Horovod user can carry their environment over unchanged, and add ``HOROVOD_TPU_``
+knobs for TPU-only behavior.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+# --- knob names (parity: common.h:64-90) ---
+HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"
+HOROVOD_CYCLE_TIME = "HOROVOD_CYCLE_TIME"
+HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
+HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
+HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
+HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
+HOROVOD_AUTOTUNE_WARMUP_SAMPLES = "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"
+HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE = "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"
+HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
+HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
+HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
+HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
+HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
+HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
+HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
+HOROVOD_CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
+HOROVOD_BATCH_D2D_MEMCOPIES = "HOROVOD_BATCH_D2D_MEMCOPIES"
+HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
+HOROVOD_RANK = "HOROVOD_RANK"
+HOROVOD_SIZE = "HOROVOD_SIZE"
+HOROVOD_LOCAL_RANK = "HOROVOD_LOCAL_RANK"
+HOROVOD_LOCAL_SIZE = "HOROVOD_LOCAL_SIZE"
+HOROVOD_CROSS_RANK = "HOROVOD_CROSS_RANK"
+HOROVOD_CROSS_SIZE = "HOROVOD_CROSS_SIZE"
+HOROVOD_HOSTNAME = "HOROVOD_HOSTNAME"
+HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
+HOROVOD_GLOO_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
+HOROVOD_GLOO_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
+HOROVOD_GLOO_TIMEOUT_SECONDS = "HOROVOD_GLOO_TIMEOUT_SECONDS"
+HOROVOD_GLOO_IFACE = "HOROVOD_GLOO_IFACE"
+
+# TPU-only knobs
+HOROVOD_TPU_COORDINATOR = "HOROVOD_TPU_COORDINATOR"          # host:port of jax coordinator
+HOROVOD_TPU_NUM_PROCESSES = "HOROVOD_TPU_NUM_PROCESSES"
+HOROVOD_TPU_PROCESS_ID = "HOROVOD_TPU_PROCESS_ID"
+HOROVOD_TPU_DEBUG_CONSISTENCY = "HOROVOD_TPU_DEBUG_CONSISTENCY"
+HOROVOD_TPU_PLATFORM = "HOROVOD_TPU_PLATFORM"                 # cpu|tpu override (tests)
+
+DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # operations.cc:432
+DEFAULT_CYCLE_TIME_MS = 5.0                        # operations.cc:440
+DEFAULT_CACHE_CAPACITY = 1024                      # operations.cc:449-456
+DEFAULT_STALL_WARNING_SECONDS = 60.0               # stall_inspector.h:75
+
+
+def _get_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _get_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None or not v.strip():
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+def _get_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if v is None or not v.strip():
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+@dataclass
+class Config:
+    """Parsed runtime configuration (analog of the knob block read at
+    operations.cc:416-513)."""
+
+    fusion_threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES
+    cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
+    cache_capacity: int = DEFAULT_CACHE_CAPACITY
+    timeline_path: Optional[str] = None
+    timeline_mark_cycles: bool = False
+    autotune: bool = False
+    autotune_log: Optional[str] = None
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+    autotune_bayes_opt_max_samples: int = 20
+    autotune_gaussian_process_noise: float = 0.8
+    stall_check_disable: bool = False
+    stall_warning_seconds: float = DEFAULT_STALL_WARNING_SECONDS
+    stall_shutdown_seconds: float = 0.0
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+    debug_consistency: bool = False
+    elastic: bool = False
+    extra: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        return cls(
+            fusion_threshold_bytes=_get_int(
+                HOROVOD_FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD_BYTES),
+            cycle_time_ms=_get_float(HOROVOD_CYCLE_TIME, DEFAULT_CYCLE_TIME_MS),
+            cache_capacity=_get_int(HOROVOD_CACHE_CAPACITY, DEFAULT_CACHE_CAPACITY),
+            timeline_path=os.environ.get(HOROVOD_TIMELINE) or None,
+            timeline_mark_cycles=_get_bool(HOROVOD_TIMELINE_MARK_CYCLES),
+            autotune=_get_bool(HOROVOD_AUTOTUNE),
+            autotune_log=os.environ.get(HOROVOD_AUTOTUNE_LOG) or None,
+            autotune_warmup_samples=_get_int(HOROVOD_AUTOTUNE_WARMUP_SAMPLES, 3),
+            autotune_steps_per_sample=_get_int(HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE, 10),
+            autotune_bayes_opt_max_samples=_get_int(
+                HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES, 20),
+            autotune_gaussian_process_noise=_get_float(
+                HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE, 0.8),
+            stall_check_disable=_get_bool(HOROVOD_STALL_CHECK_DISABLE),
+            stall_warning_seconds=_get_float(
+                HOROVOD_STALL_CHECK_TIME_SECONDS, DEFAULT_STALL_WARNING_SECONDS),
+            stall_shutdown_seconds=_get_float(HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, 0.0),
+            hierarchical_allreduce=_get_bool(HOROVOD_HIERARCHICAL_ALLREDUCE),
+            hierarchical_allgather=_get_bool(HOROVOD_HIERARCHICAL_ALLGATHER),
+            debug_consistency=_get_bool(HOROVOD_TPU_DEBUG_CONSISTENCY),
+            elastic=_get_bool(HOROVOD_ELASTIC),
+        )

@@ -1,0 +1,35 @@
+"""Exception types (parity: horovod/common/exceptions.py:1-31)."""
+
+
+class HorovodInternalError(RuntimeError):
+    """Internal error raised when a collective routine is shut down or fails.
+
+    In the elastic run loop this triggers state restore + re-rendezvous
+    (reference: common/elastic.py:147-168).
+    """
+
+
+class HostsUpdatedInterrupt(RuntimeError):
+    """Raised when the set of available hosts changed mid-training.
+
+    ``skip_sync`` mirrors the reference's distinction between an update caused
+    by host addition (state still valid, no re-sync needed) vs a failure.
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__("hosts updated")
+        self.skip_sync = skip_sync
+
+
+class TensorShapeMismatchError(ValueError):
+    """Cross-rank shape disagreement (reference surfaces these as ERROR
+    responses built in controller.cc:380-623)."""
+
+
+class TensorDtypeMismatchError(ValueError):
+    """Cross-rank dtype disagreement (controller.cc:380-623)."""
+
+
+class DuplicateNameError(ValueError):
+    """A tensor with the same name was submitted twice before completion
+    (reference: common.h:163-166 DUPLICATE_NAME_ERROR, tensor_queue.h:32)."""

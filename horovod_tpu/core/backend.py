@@ -1,0 +1,148 @@
+"""Process/world state backend.
+
+Plays the role of the reference's control-plane contexts
+(mpi/mpi_context.{h,cc}: global/local/cross communicators;
+gloo/gloo_context.cc:127-219 rendezvous) on top of the JAX distributed
+coordinator. Topology:
+
+- **rank/size** — process-level, like an MPI rank (``jax.process_index`` /
+  ``jax.process_count``).
+- **local_rank/local_size** — position within the host (derived from
+  HOROVOD_LOCAL_RANK env set by the launcher, or 0/1).
+- **cross_rank/cross_size** — position across hosts at the same local rank
+  (controller.h:119-127 accessors).
+
+The backend also owns the *eager group mesh*: a 1-D mesh with exactly one
+device per process, over which the eager named-tensor collectives execute. The
+full device mesh (every chip) is exposed separately for SPMD training.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..common import env as env_mod
+from ..common.exceptions import HorovodInternalError
+from ..parallel.mesh import WORLD_AXIS
+
+
+class Backend:
+    """World/topology state + array plumbing for eager collectives."""
+
+    def __init__(self):
+        self._initialized = False
+        self._rank = 0
+        self._size = 1
+        self._local_rank = 0
+        self._local_size = 1
+        self._cross_rank = 0
+        self._cross_size = 1
+        self._group_mesh: Optional[Mesh] = None
+        self._group_sharding = None
+        self._rep_sharding = None
+        self._distributed = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def init(self):
+        if self._initialized:
+            return
+        coord = os.environ.get(env_mod.HOROVOD_TPU_COORDINATOR)
+        nprocs = os.environ.get(env_mod.HOROVOD_TPU_NUM_PROCESSES)
+        if coord and nprocs and int(nprocs) > 1:
+            proc_id = int(os.environ.get(env_mod.HOROVOD_TPU_PROCESS_ID,
+                                         os.environ.get(env_mod.HOROVOD_RANK, "0")))
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=int(nprocs),
+                                       process_id=proc_id)
+            self._distributed = True
+        self._rank = jax.process_index()
+        self._size = jax.process_count()
+        self._local_rank = int(os.environ.get(env_mod.HOROVOD_LOCAL_RANK, "0"))
+        self._local_size = int(os.environ.get(env_mod.HOROVOD_LOCAL_SIZE, "1"))
+        self._cross_rank = int(os.environ.get(env_mod.HOROVOD_CROSS_RANK,
+                                              str(self._rank // max(self._local_size, 1))))
+        self._cross_size = int(os.environ.get(env_mod.HOROVOD_CROSS_SIZE,
+                                              str(max(1, self._size // max(self._local_size, 1)))))
+        # One device per process for the eager group mesh. Pick each process's
+        # first local device, ordered by process index.
+        per_proc = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, d)
+        devs = [per_proc[i] for i in sorted(per_proc.keys())]
+        if len(devs) != self._size:
+            raise HorovodInternalError(
+                f"expected one device per process ({self._size}), found {len(devs)}")
+        self._group_mesh = Mesh(np.array(devs), (WORLD_AXIS,))
+        self._group_sharding = NamedSharding(self._group_mesh, P(WORLD_AXIS))
+        self._rep_sharding = NamedSharding(self._group_mesh, P())
+        self._initialized = True
+
+    def shutdown(self):
+        if self._distributed:
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            self._distributed = False
+        self._initialized = False
+        self._group_mesh = None
+
+    @property
+    def initialized(self) -> bool:
+        return self._initialized
+
+    # -- topology ----------------------------------------------------------
+
+    def rank(self) -> int:
+        return self._rank
+
+    def size(self) -> int:
+        return self._size
+
+    def local_rank(self) -> int:
+        return self._local_rank
+
+    def local_size(self) -> int:
+        return self._local_size
+
+    def cross_rank(self) -> int:
+        return self._cross_rank
+
+    def cross_size(self) -> int:
+        return self._cross_size
+
+    def is_homogeneous(self) -> bool:
+        """Reference: mpi_controller.cc:26-82 homogeneity check. With a JAX
+        backend every process addresses the same chip count per host."""
+        return self._size % max(self._local_size, 1) == 0
+
+    @property
+    def group_mesh(self) -> Mesh:
+        return self._group_mesh
+
+    # -- array plumbing ----------------------------------------------------
+
+    def to_global(self, local_value) -> jax.Array:
+        """Lift this process's tensor to a stacked global array of shape
+        (size, *s), sharded one slice per process over the group mesh."""
+        import jax.numpy as jnp
+        x = jnp.asarray(local_value)
+        local_dev = self._group_mesh.devices.flat[self._rank]
+        shard = jax.device_put(x[None], local_dev)
+        global_shape = (self._size,) + tuple(x.shape)
+        return jax.make_array_from_single_device_arrays(
+            global_shape, self._group_sharding, [shard])
+
+    def from_global(self, garr: jax.Array):
+        """Extract this process's slice of a stacked (size, *s) result."""
+        for s in garr.addressable_shards:
+            if s.index[0].start == self._rank or self._size == 1:
+                return s.data[0]
+        # Fallback: single addressable shard
+        return garr.addressable_shards[0].data[0]

@@ -1,0 +1,392 @@
+"""Eager collective engine: the TPU-native analog of the reference's core
+runtime loop.
+
+The reference funnels every framework op through EnqueueTensorAllreduce/...
+(operations.cc:824-1040) into a TensorQueue drained by a background thread that
+negotiates, fuses, and launches NCCL/MPI kernels (operations.cc:354-616). Under
+JAX none of that machinery is needed for correctness: dispatch is already
+asynchronous (the XLA runtime queues work on device streams) and SPMD execution
+makes cross-rank readiness implicit. What remains, and lives here:
+
+- **Handle-based async API** (parity: torch/handle_manager.{h,cc} +
+  torch/mpi_ops.py poll/synchronize): every op returns a handle; ``poll`` maps
+  to ``jax.Array`` readiness, ``synchronize`` to ``block_until_ready``.
+- **Duplicate-name detection** (common.h:163-166 DUPLICATE_NAME_ERROR).
+- **Fusion/bucketing** for grouped ops (controller.cc:652-773 FuseResponses +
+  fusion_buffer_manager): tensors are packed into <= threshold-byte buckets per
+  dtype and reduced with one collective launch per bucket.
+- **Builder cache** (the jit-compile analog of the ResponseCache,
+  response_cache.h:45-102): steady-state ops skip all Python-side setup.
+- **Timeline + stall-inspector hooks** around enqueue/completion.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..common import env as env_mod
+from ..common.exceptions import DuplicateNameError
+from ..common.reduce_ops import ReduceOp
+from ..ops import collectives as C
+from ..parallel.mesh import WORLD_AXIS
+from .backend import Backend
+
+
+class Handle:
+    """Async op handle. Readiness *is* the underlying jax.Array's readiness
+    (replaces ReadyEvent + finalizer thread, gpu_operations.cc:47-87).
+    Completion is driven both by the user (poll/synchronize) and by the
+    engine's cycle loop, so fire-and-forget ops still clear the outstanding
+    table and feed the stall inspector/timeline."""
+
+    __slots__ = ("name", "_garrs", "_extract", "_engine", "_done", "_result",
+                 "_finish_lock", "enqueue_time", "recv_sizes")
+
+    def __init__(self, name: str, garrs: List[jax.Array], extract: Callable,
+                 engine: "Engine"):
+        self.name = name
+        self._garrs = garrs
+        self._extract = extract
+        self._engine = engine
+        self._done = False
+        self._result = None
+        self._finish_lock = threading.Lock()
+        self.enqueue_time = time.time()
+        self.recv_sizes = None  # per-rank dim-0 sizes for allgather results
+
+    def poll(self) -> bool:
+        if self._done:
+            return True
+        try:
+            ready = all(g.is_ready() for g in self._garrs)
+        except AttributeError:  # older jax without is_ready
+            ready = True
+        if ready:
+            self._finish()
+        return self._done
+
+    def synchronize(self):
+        if not self._done:
+            for g in self._garrs:
+                g.block_until_ready()
+            self._finish()
+        return self._result
+
+    def _finish(self):
+        with self._finish_lock:
+            if self._done:
+                return
+            self._result = self._extract(self._garrs)
+            self._done = True
+        self._engine._on_complete(self)
+
+
+class HandleManager:
+    """int handle -> Handle map (parity: torch/handle_manager.{h,cc})."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = 0
+        self._handles: Dict[int, Handle] = {}
+
+    def allocate(self, h: Handle) -> int:
+        with self._lock:
+            hid = self._next
+            self._next += 1
+            self._handles[hid] = h
+            return hid
+
+    def get(self, hid: int) -> Handle:
+        with self._lock:
+            if hid not in self._handles:
+                raise ValueError(f"unknown handle {hid}")
+            return self._handles[hid]
+
+    def release(self, hid: int):
+        with self._lock:
+            self._handles.pop(hid, None)
+
+
+class Engine:
+    def __init__(self, backend: Backend, config: env_mod.Config):
+        self.backend = backend
+        self.config = config
+        self.handles = HandleManager()
+        self._builders: Dict[tuple, Callable] = {}
+        self._outstanding: Dict[str, Handle] = {}
+        self._lock = threading.Lock()
+        self._auto_counter = {}
+        # observability hooks, wired by GlobalState when timeline/stall are on
+        self.on_enqueue: Optional[Callable[[str, str, int], None]] = None
+        self.on_done: Optional[Callable[[str], None]] = None
+        # Cycle loop: the analog of RunLoopOnce (operations.cc:566-616) — wakes
+        # every cycle_time_ms to retire completed handles so fire-and-forget
+        # async ops clear the outstanding table without user poll/synchronize.
+        self._running = True
+        self._cycle_thread = threading.Thread(target=self._cycle_loop,
+                                              name="hvd-cycle", daemon=True)
+        self._cycle_thread.start()
+
+    def stop(self):
+        self._running = False
+
+    def _cycle_loop(self):
+        period = max(self.config.cycle_time_ms, 1.0) / 1000.0
+        while self._running:
+            time.sleep(period)
+            with self._lock:
+                pending = list(self._outstanding.values())
+            for h in pending:
+                try:
+                    h.poll()
+                except Exception:  # retire errors surface at synchronize time
+                    pass
+
+    # -- internals ---------------------------------------------------------
+
+    def _axis(self) -> str:
+        return WORLD_AXIS
+
+    def _builder(self, key: tuple, make: Callable):
+        fn = self._builders.get(key)
+        if fn is None:
+            fn = make()
+            self._builders[key] = fn
+        return fn
+
+    def _auto_name(self, kind: str) -> str:
+        n = self._auto_counter.get(kind, 0)
+        self._auto_counter[kind] = n + 1
+        return f"{kind}.noname.{n}"
+
+    def _register(self, name: Optional[str], kind: str, nbytes: int) -> str:
+        name = name or self._auto_name(kind)
+        with self._lock:
+            existing = self._outstanding.get(name)
+        if existing is not None:
+            # The prior op may have completed on-device without anyone polling
+            # yet — only a genuinely in-flight duplicate is an error
+            # (common.h:163-166 DUPLICATE_NAME_ERROR).
+            if not existing.poll():
+                raise DuplicateNameError(
+                    f"Duplicate tensor name {name!r} submitted before the prior "
+                    f"operation completed (common.h:163-166)")
+        if self.on_enqueue is not None:
+            self.on_enqueue(name, kind, nbytes)
+        return name
+
+    def _track(self, name: str, h: Handle):
+        with self._lock:
+            self._outstanding[name] = h
+
+    def _on_complete(self, h: Handle):
+        with self._lock:
+            self._outstanding.pop(h.name, None)
+        if self.on_done is not None:
+            self.on_done(h.name)
+
+    def _single(self, name: str, garr: jax.Array) -> Handle:
+        h = Handle(name, [garr], lambda gs: self.backend.from_global(gs[0]), self)
+        self._track(name, h)
+        return h
+
+    # -- collectives -------------------------------------------------------
+
+    def allreduce(self, tensor, name: Optional[str] = None,
+                  op: ReduceOp = ReduceOp.SUM,
+                  prescale_factor: float = 1.0,
+                  postscale_factor: float = 1.0) -> Handle:
+        x = jnp.asarray(tensor)
+        name = self._register(name, "allreduce", x.nbytes)
+        mesh = self.backend.group_mesh
+        fn = self._builder(("allreduce", op, prescale_factor, postscale_factor),
+                           lambda: C.build_allreduce(mesh, self._axis(), op,
+                                                     prescale_factor,
+                                                     postscale_factor))
+        out = fn(self.backend.to_global(x))
+        return self._single(name, out)
+
+    def grouped_allreduce(self, tensors: Sequence, name: Optional[str] = None,
+                          op: ReduceOp = ReduceOp.SUM,
+                          prescale_factor: float = 1.0,
+                          postscale_factor: float = 1.0) -> List[Handle]:
+        """Fused allreduce of many tensors: bucketed packing (one collective per
+        <= fusion_threshold bucket per dtype), mirroring FuseResponses
+        (controller.cc:652-773)."""
+        tensors = [jnp.asarray(t) for t in tensors]
+        names = [self._register(None if name is None else f"{name}.{i}",
+                                "grouped_allreduce", t.nbytes)
+                 for i, t in enumerate(tensors)]
+        buckets = bucket_by_size(tensors, self.config.fusion_threshold_bytes)
+        mesh = self.backend.group_mesh
+        fn = self._builder(("allreduce", op, prescale_factor, postscale_factor),
+                           lambda: C.build_allreduce(mesh, self._axis(), op,
+                                                     prescale_factor,
+                                                     postscale_factor))
+        results: Dict[int, jax.Array] = {}
+        for idxs in buckets:
+            packed, treedef = C.pack([tensors[i] for i in idxs])
+            out = fn(self.backend.to_global(packed))
+            # one global array per bucket; defer unpack to extraction
+            for pos, i in enumerate(idxs):
+                results[i] = (out, treedef, pos)
+        handles = []
+        for i, nm in enumerate(names):
+            garr, treedef, pos = results[i]
+
+            def extract(gs, treedef=treedef, pos=pos):
+                local = self.backend.from_global(gs[0])
+                return C.unpack(local, treedef)[pos]
+
+            h = Handle(nm, [garr], extract, self)
+            self._track(nm, h)
+            handles.append(h)
+        return handles
+
+    def allgather(self, tensor, name: Optional[str] = None) -> Handle:
+        """Allgather with possibly different dim-0 sizes per rank
+        (collective_operations.cc:88-195 displacement math): a small size
+        exchange first, then pad to max and gather, then slice+concat."""
+        x = jnp.asarray(tensor)
+        name = self._register(name, "allgather", x.nbytes)
+        mesh = self.backend.group_mesh
+        size = self.backend.size()
+        d0 = int(x.shape[0]) if x.ndim else 1
+        sizes = self._exchange_sizes(np.array([d0], dtype=np.int32))[:, 0]
+        max_d0 = int(sizes.max()) if size > 1 else d0
+        if x.ndim == 0:
+            x = x[None]
+        pad = max_d0 - d0
+        xp = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1)) if pad else x
+        fn = self._builder(("allgather",), lambda: C.build_allgather(mesh, self._axis()))
+        out = fn(self.backend.to_global(xp))
+
+        def extract(gs):
+            local = self.backend.from_global(gs[0])  # (size*max_d0, *s)
+            if all(int(s) == max_d0 for s in sizes):
+                return local
+            parts = [local[r * max_d0: r * max_d0 + int(sizes[r])]
+                     for r in range(size)]
+            return jnp.concatenate(parts, axis=0)
+
+        h = Handle(name, [out], extract, self)
+        h.recv_sizes = np.asarray(sizes)
+        self._track(name, h)
+        return h
+
+    def broadcast(self, tensor, root_rank: int, name: Optional[str] = None) -> Handle:
+        x = jnp.asarray(tensor)
+        name = self._register(name, "broadcast", x.nbytes)
+        mesh = self.backend.group_mesh
+        fn = self._builder(("broadcast", root_rank),
+                           lambda: C.build_broadcast(mesh, self._axis(), root_rank))
+        out = fn(self.backend.to_global(x))
+        return self._single(name, out)
+
+    def alltoall(self, tensor, splits=None, name: Optional[str] = None) -> Handle:
+        """Alltoall with optional uneven splits (operations.cc:951,
+        mpi_operations.cc:380 MPI_Alltoallv semantics). Returns handle whose
+        result is (received_tensor, recv_splits)."""
+        x = jnp.asarray(tensor)
+        name = self._register(name, "alltoall", x.nbytes)
+        size = self.backend.size()
+        mesh = self.backend.group_mesh
+        if splits is None:
+            if int(x.shape[0]) % size != 0:
+                raise ValueError(
+                    f"alltoall without splits requires dim0 ({x.shape[0]}) divisible "
+                    f"by size ({size})")
+            splits = np.full((size,), int(x.shape[0]) // size, dtype=np.int32)
+        else:
+            splits = np.asarray(splits, dtype=np.int32)
+            if splits.sum() != int(x.shape[0]):
+                raise ValueError("splits must sum to tensor dim 0")
+        # Exchange the full splits matrix: recv_splits[r] = splits_of_rank_r[me]
+        # (controller's AlltoallGetRecvSplits, mpi_controller.cc:212).
+        all_splits = self._exchange_sizes(splits)  # (size, size)
+        me = self.backend.rank()
+        recv_splits = all_splits[:, me]
+        max_chunk = int(all_splits.max()) if size > 1 else int(splits.max())
+        # Pad each send chunk to max_chunk, run equal alltoall, slice out.
+        offs = np.concatenate([[0], np.cumsum(splits)[:-1]])
+        chunks = [jax.lax.dynamic_slice_in_dim(x, int(offs[r]), int(splits[r]))
+                  for r in range(size)]
+        padded = jnp.concatenate([
+            jnp.pad(c, [(0, max_chunk - c.shape[0])] + [(0, 0)] * (x.ndim - 1))
+            for c in chunks]) if size > 1 else x
+        fn = self._builder(("alltoall",), lambda: C.build_alltoall(mesh, self._axis()))
+        out = fn(self.backend.to_global(padded))
+
+        def extract(gs):
+            local = self.backend.from_global(gs[0])  # (size*max_chunk, *s)
+            if size == 1:
+                return local, jnp.asarray(recv_splits)
+            parts = [local[r * max_chunk: r * max_chunk + int(recv_splits[r])]
+                     for r in range(size)]
+            return jnp.concatenate(parts, axis=0), jnp.asarray(recv_splits)
+
+        h = Handle(name, [out], extract, self)
+        self._track(name, h)
+        return h
+
+    def reducescatter(self, tensor, name: Optional[str] = None,
+                      op: ReduceOp = ReduceOp.SUM) -> Handle:
+        if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            raise ValueError(f"reducescatter supports Sum and Average, got {op!r}")
+        x = jnp.asarray(tensor)
+        name = self._register(name, "reducescatter", x.nbytes)
+        size = self.backend.size()
+        if int(x.shape[0]) % size != 0:
+            raise ValueError("reducescatter requires dim0 divisible by size")
+        mesh = self.backend.group_mesh
+        fn = self._builder(("reducescatter", op),
+                           lambda: C.build_reducescatter(mesh, self._axis(), op))
+        out = fn(self.backend.to_global(x))
+        return self._single(name, out)
+
+    def barrier(self):
+        mesh = self.backend.group_mesh
+        fn = self._builder(("barrier",), lambda: C.build_barrier(mesh, self._axis()))
+        out = fn(self.backend.to_global(jnp.zeros((), jnp.int32)))
+        out.block_until_ready()
+
+    # -- helpers -----------------------------------------------------------
+
+    def _exchange_sizes(self, local_vec: np.ndarray) -> np.ndarray:
+        """Tiny metadata allgather used by unequal allgather/alltoall; the
+        eager analog of the controller's size negotiation. Blocking (returns
+        concrete numpy)."""
+        if self.backend.size() == 1:
+            return np.asarray(local_vec)[None]
+        mesh = self.backend.group_mesh
+        fn = self._builder(("allgather",), lambda: C.build_allgather(mesh, self._axis()))
+        garr = fn(self.backend.to_global(jnp.asarray(local_vec)))
+        local = self.backend.from_global(garr)
+        return np.asarray(local).reshape(self.backend.size(), *local_vec.shape)
+
+
+def bucket_by_size(tensors: Sequence[jax.Array], threshold_bytes: int) -> List[List[int]]:
+    """Group tensor indices into fusion buckets: same dtype, cumulative size
+    <= threshold (mixed-dtype look-ahead of controller.cc:652-773 becomes
+    simple per-dtype bucketing since packing is free under XLA)."""
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    cur_dtype = None
+    for i, t in enumerate(tensors):
+        nb = t.nbytes
+        if cur and (t.dtype != cur_dtype or cur_bytes + nb > threshold_bytes):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nb
+        cur_dtype = t.dtype
+    if cur:
+        buckets.append(cur)
+    return buckets

@@ -1,0 +1,91 @@
+"""Global runtime state (parity: horovod/common/global_state.h:42-122
+HorovodGlobalState). Owns the backend, engine, and — as later slices land —
+timeline, stall inspector, and parameter manager."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..common import env as env_mod
+from .backend import Backend
+from .engine import Engine
+
+
+class GlobalState:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.backend: Optional[Backend] = None
+        self.engine: Optional[Engine] = None
+        self.config: Optional[env_mod.Config] = None
+        self.timeline = None
+        self.stall_inspector = None
+        self.parameter_manager = None
+
+    def init(self):
+        with self._lock:
+            if self.backend is not None and self.backend.initialized:
+                return
+            self.config = env_mod.Config.from_env()
+            self.backend = Backend()
+            self.backend.init()
+            self.engine = Engine(self.backend, self.config)
+            self._wire_observability()
+
+    def _wire_observability(self):
+        cfg = self.config
+        if cfg.timeline_path and self.backend.rank() == 0:
+            from ..timeline import Timeline
+            self.timeline = Timeline(cfg.timeline_path,
+                                     mark_cycles=cfg.timeline_mark_cycles)
+            self.timeline.start()
+        if not cfg.stall_check_disable:
+            from ..stall_inspector import StallInspector
+            self.stall_inspector = StallInspector(
+                warning_seconds=cfg.stall_warning_seconds,
+                shutdown_seconds=cfg.stall_shutdown_seconds)
+
+        engine = self.engine
+        timeline = self.timeline
+        stall = self.stall_inspector
+
+        def on_enqueue(name, kind, nbytes):
+            if timeline is not None:
+                timeline.record_enqueue(name, kind, nbytes)
+            if stall is not None:
+                stall.record_enqueue(name)
+
+        def on_done(name):
+            if timeline is not None:
+                timeline.record_done(name)
+            if stall is not None:
+                stall.record_done(name)
+
+        engine.on_enqueue = on_enqueue
+        engine.on_done = on_done
+
+    def shutdown(self):
+        with self._lock:
+            if self.engine is not None:
+                self.engine.stop()
+            if self.timeline is not None:
+                self.timeline.stop()
+                self.timeline = None
+            if self.stall_inspector is not None:
+                self.stall_inspector.stop()
+                self.stall_inspector = None
+            if self.backend is not None:
+                self.backend.shutdown()
+            self.backend = None
+            self.engine = None
+
+    @property
+    def initialized(self) -> bool:
+        return self.backend is not None and self.backend.initialized
+
+
+_global_state = GlobalState()
+
+
+def global_state() -> GlobalState:
+    return _global_state

@@ -1,0 +1,90 @@
+"""High-level state-sync helpers (parity: horovod/torch/functions.py —
+broadcast_parameters :30, broadcast_optimizer_state :62, broadcast_object :186,
+allgather_object :229; horovod/tensorflow/functions.py:59-101
+broadcast_object via cloudpickle→uint8 tensor).
+
+Model/optimizer state here is any JAX pytree, so one set of helpers covers all
+frontends.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core.state import global_state
+
+
+def _engine():
+    st = global_state()
+    if not st.initialized:
+        raise ValueError("horovod_tpu has not been initialized; run hvd.init() first.")
+    return st.engine
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
+    """Broadcast a pytree of arrays from ``root_rank`` to all processes,
+    returning the synchronized pytree (functional analog of
+    torch/functions.py:30 broadcast_parameters, which mutates in place)."""
+    eng = _engine()
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if eng.backend.size() == 1:
+        return params
+    handles = [eng.broadcast(leaf, root_rank, name=f"broadcast.param.{i}")
+               for i, leaf in enumerate(leaves)]
+    new_leaves = [h.synchronize() for h in handles]
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0) -> Any:
+    """Optimizer state is a pytree under optax — same path as parameters
+    (reference needed a separate walker for torch optimizer dicts,
+    torch/functions.py:62)."""
+    return broadcast_parameters(opt_state, root_rank)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0, name: Optional[str] = None) -> Any:
+    """Pickle an arbitrary object, broadcast its length then its bytes as a
+    uint8 tensor (reference: tensorflow/functions.py:59-101,
+    torch/functions.py:186)."""
+    eng = _engine()
+    if eng.backend.size() == 1:
+        return obj
+    name = name or "broadcast_object"
+    if eng.backend.rank() == root_rank:
+        data = pickle.dumps(obj)
+        sz = np.array([len(data)], dtype=np.int32)
+    else:
+        data = b""
+        sz = np.array([0], dtype=np.int32)
+    sz = np.asarray(eng.broadcast(sz, root_rank, name=f"{name}.sz").synchronize())
+    nbytes = int(sz[0])
+    buf = np.frombuffer(data, dtype=np.uint8) if data else np.zeros(nbytes, np.uint8)
+    if buf.shape[0] != nbytes:
+        buf = np.zeros(nbytes, np.uint8)
+    out = np.asarray(eng.broadcast(buf, root_rank, name=f"{name}.data").synchronize())
+    return pickle.loads(out.tobytes())
+
+
+def allgather_object(obj: Any, name: Optional[str] = None) -> list:
+    """Gather arbitrary objects from all processes into a list ordered by rank
+    (reference: torch/functions.py:229)."""
+    eng = _engine()
+    if eng.backend.size() == 1:
+        return [obj]
+    name = name or "allgather_object"
+    data = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    h = eng.allgather(data, name=name)
+    gathered = np.asarray(h.synchronize())
+    sizes = h.recv_sizes  # engine.allgather already exchanged per-rank sizes
+    out = []
+    off = 0
+    for s in sizes:
+        out.append(pickle.loads(gathered[off:off + int(s)].tobytes()))
+        off += int(s)
+    return out

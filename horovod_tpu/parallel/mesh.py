@@ -1,0 +1,89 @@
+"""Device-mesh construction for the TPU-native runtime.
+
+The reference's topology model is GLOBAL/LOCAL/CROSS communicators
+(horovod/common/common.h:113-117, mpi/mpi_context.cc splits). The TPU-native
+equivalent is a ``jax.sharding.Mesh``:
+
+- 1-D ``world`` mesh — the global communicator; every collective defaults here.
+- 2-D ``(cross, local)`` mesh — the hierarchical decomposition used by
+  NCCLHierarchicalAllreduce (ops/nccl_operations.cc:180-383): on TPU, ``local``
+  maps onto the ICI-connected slice and ``cross`` onto the DCN axis between
+  slices/hosts.
+- N-D training meshes (``data``/``fsdp``/``tensor``/``seq``/``expert``/``pipe``)
+  for SPMD parallelism beyond the reference's DP-only surface.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+WORLD_AXIS = "world"
+CROSS_AXIS = "cross"   # inter-node / DCN axis
+LOCAL_AXIS = "local"   # intra-node / ICI axis
+
+
+def world_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D mesh over every device — the GLOBAL communicator."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    return Mesh(np.array(devs), (WORLD_AXIS,))
+
+
+def hierarchical_mesh(local_size: Optional[int] = None,
+                      devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """2-D (cross, local) mesh for hierarchical collectives.
+
+    ``local_size`` defaults to the per-process device count (the TPU analog of
+    ranks-per-node used by the reference's local communicator split,
+    mpi/mpi_context.cc). Falls back to the largest power-of-2-ish divisor when
+    the world size is not divisible.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = len(devs)
+    if local_size is None:
+        local_size = max(1, len([d for d in devs if d.process_index == devs[0].process_index]))
+    if n % local_size != 0:
+        # fall back to the largest divisor of n that is <= local_size
+        local_size = max(d for d in range(1, local_size + 1) if n % d == 0)
+    cross = n // local_size
+    arr = np.array(devs).reshape(cross, local_size)
+    return Mesh(arr, (CROSS_AXIS, LOCAL_AXIS))
+
+
+def training_mesh(axis_sizes: dict,
+                  devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """N-D SPMD training mesh, e.g. {'data': 2, 'tensor': 2, 'seq': 2}.
+
+    Any axis given size -1 absorbs the remaining devices. Axis order in the
+    dict is the mesh-major order: put the axis that should ride DCN first and
+    the most bandwidth-hungry axis (tensor) last so it lands on the
+    innermost/fastest ICI ring.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = len(devs)
+    names = list(axis_sizes.keys())
+    sizes = list(axis_sizes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("only one axis may be -1")
+    known = math.prod(s for s in sizes if s != -1)
+    if -1 in sizes:
+        if n % known != 0:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    if math.prod(sizes) != n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {math.prod(sizes)} "
+                         f"devices, have {n}")
+    arr = np.array(devs).reshape(tuple(sizes))
+    return Mesh(arr, tuple(names))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def sharded_axis0(mesh: Mesh, axis: str = WORLD_AXIS) -> NamedSharding:
+    return NamedSharding(mesh, P(axis))

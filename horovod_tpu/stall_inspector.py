@@ -1,0 +1,73 @@
+"""Stall inspector (parity: horovod/common/stall_inspector.{h,cc}).
+
+The reference's coordinator warns when some ranks have submitted a tensor and
+others have not for >60s (stall_inspector.h:75) and can optionally shut the job
+down (stall_inspector.h:80). Under SPMD an un-matched collective manifests as a
+*hang* of an enqueued op, so our inspector watches the per-process outstanding
+set: any op enqueued but not completed for longer than the warning threshold is
+reported; past the shutdown threshold we raise in the watcher and abort.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict
+
+logger = logging.getLogger("horovod_tpu")
+
+
+class StallInspector:
+    def __init__(self, warning_seconds: float = 60.0, shutdown_seconds: float = 0.0,
+                 check_interval: float = 5.0):
+        self.warning_seconds = warning_seconds
+        self.shutdown_seconds = shutdown_seconds
+        self.check_interval = check_interval
+        self._lock = threading.Lock()
+        self._outstanding: Dict[str, float] = {}
+        self._warned: set = set()
+        self._running = True
+        self._thread = threading.Thread(target=self._watch, name="hvd-stall",
+                                        daemon=True)
+        self._thread.start()
+
+    def record_enqueue(self, name: str):
+        with self._lock:
+            self._outstanding[name] = time.monotonic()
+
+    def record_done(self, name: str):
+        with self._lock:
+            self._outstanding.pop(name, None)
+            self._warned.discard(name)
+
+    def stalled_tensors(self):
+        now = time.monotonic()
+        with self._lock:
+            return [(n, now - t) for n, t in self._outstanding.items()
+                    if now - t > self.warning_seconds]
+
+    def stop(self):
+        self._running = False
+
+    def _watch(self):
+        while self._running:
+            time.sleep(self.check_interval)
+            now = time.monotonic()
+            with self._lock:
+                items = list(self._outstanding.items())
+            for name, t0 in items:
+                age = now - t0
+                if age > self.warning_seconds and name not in self._warned:
+                    logger.warning(
+                        "One or more tensors were submitted to be reduced/gathered "
+                        "but have not completed for %.0f s: %s. This may indicate a "
+                        "rank that stopped contributing (stall_inspector.h:75 "
+                        "analog).", age, name)
+                    with self._lock:
+                        self._warned.add(name)
+                if self.shutdown_seconds > 0 and age > self.shutdown_seconds:
+                    logger.error("Stalled tensor %s exceeded shutdown threshold "
+                                 "%.0f s; aborting.", name, self.shutdown_seconds)
+                    os._exit(64)

@@ -1,0 +1,119 @@
+"""Chrome-tracing timeline writer (parity: horovod/common/timeline.{h,cc}).
+
+Writes catapult-format JSON (timeline.h:79-81). Events are pushed onto a queue
+drained by a dedicated writer thread — the same design as the reference's
+boost lock-free SPSC queue + writer thread (timeline.h:66-75), here a
+``queue.SimpleQueue``. Per-tensor lifecycle: ENQUEUE (analogous to the
+NEGOTIATING phase, controller.cc:809-821 — SPMD needs no negotiation so the
+span covers enqueue→completion) then the op activity span.
+
+A C++ writer with the same wire format lives in native/ (Slice 6); this Python
+writer is the fallback and the reference implementation for tests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import re
+import threading
+import time
+from typing import Optional
+
+_AUTO_NAME_RE = re.compile(r"\.noname\.\d+$")
+_MAX_TIDS = 4096
+
+
+class Timeline:
+    def __init__(self, path: str, mark_cycles: bool = False):
+        self.path = path
+        self.mark_cycles = mark_cycles
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._start = time.monotonic()
+        self._pending = {}
+        self._tids = {}
+        self._next_tid = 1
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._writer, name="hvd-timeline",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        if not self._running:
+            return
+        self._running = False
+        self._q.put(None)
+        self._thread.join(timeout=5)
+        self._thread = None
+
+    # -- event recording (any thread) -------------------------------------
+
+    def _ts_us(self) -> float:
+        return (time.monotonic() - self._start) * 1e6
+
+    def _tid(self, name: str) -> int:
+        # Collapse auto-generated names ("allreduce.noname.N") onto one trace
+        # row per op kind and cap the map, so long unnamed-op runs don't grow
+        # host memory or tid count without bound (the reference reuses
+        # per-tensor-name rows, timeline.h:77).
+        key = _AUTO_NAME_RE.sub(".noname", name)
+        tid = self._tids.get(key)
+        if tid is None:
+            if len(self._tids) >= _MAX_TIDS:
+                return 0
+            tid = self._next_tid
+            self._next_tid += 1
+            self._tids[key] = tid
+        return tid
+
+    def record_enqueue(self, name: str, kind: str, nbytes: int):
+        self._q.put({"name": kind.upper(), "ph": "B", "ts": self._ts_us(),
+                     "pid": 0, "tid": self._tid(name),
+                     "args": {"tensor": name, "bytes": nbytes}})
+
+    def record_done(self, name: str):
+        self._q.put({"name": "", "ph": "E", "ts": self._ts_us(),
+                     "pid": 0, "tid": self._tid(name)})
+
+    def record_activity(self, name: str, activity: str, dur_us: float):
+        self._q.put({"name": activity, "ph": "X", "ts": self._ts_us() - dur_us,
+                     "dur": dur_us, "pid": 0, "tid": self._tid(name)})
+
+    def mark_cycle(self):
+        if self.mark_cycles:
+            self._q.put({"name": "CYCLE", "ph": "i", "ts": self._ts_us(),
+                         "pid": 0, "tid": 0, "s": "g"})
+
+    # -- writer thread -----------------------------------------------------
+
+    def _writer(self):
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(self.path, "w") as f:
+            f.write("[\n")
+            first = True
+            while True:
+                try:
+                    ev = self._q.get(timeout=0.5)
+                except queue.Empty:
+                    if not self._running:
+                        break
+                    continue
+                if ev is None:
+                    break
+                if not first:
+                    f.write(",\n")
+                json.dump(ev, f)
+                first = False
+                f.flush()
+            f.write("\n]\n")

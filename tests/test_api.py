@@ -1,0 +1,158 @@
+"""Top-level eager API tests in a size-1 world (single process).
+
+Multi-process eager semantics are covered by the launcher integration tests
+(tests/test_launcher.py), matching the reference's split between in-process
+unit tests and under-mpirun tests.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+
+
+@pytest.fixture(scope="module", autouse=True)
+def init_hvd():
+    hvd.init()
+    yield
+
+
+def test_topology():
+    assert hvd.is_initialized()
+    assert hvd.size() == 1
+    assert hvd.rank() == 0
+    assert hvd.local_rank() == 0
+    assert hvd.local_size() == 1
+    assert hvd.cross_size() == 1
+    assert hvd.is_homogeneous()
+    assert hvd.xla_built() and hvd.xla_enabled()
+    assert not hvd.mpi_built() and not hvd.nccl_built() and not hvd.gloo_built()
+
+
+def test_allreduce_identity_size1():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out = np.asarray(hvd.allreduce(x, name="t1", op=hvd.Sum))
+    np.testing.assert_array_equal(out, x)
+    out2 = np.asarray(hvd.allreduce(x, name="t2", op=hvd.Average))
+    np.testing.assert_array_equal(out2, x)
+
+
+def test_async_handle_poll_synchronize():
+    x = np.ones((5,), np.float32)
+    h = hvd.allreduce_async(x, name="async1")
+    res = hvd.synchronize(h)
+    assert hvd.poll(h)
+    np.testing.assert_array_equal(np.asarray(res), x)
+
+
+def test_legacy_average_arg():
+    x = np.ones((4,), np.float32)
+    out = np.asarray(hvd.allreduce(x, name="avg_legacy", average=True))
+    np.testing.assert_array_equal(out, x)
+    with pytest.raises(ValueError):
+        hvd.allreduce(x, name="both_args", op=hvd.Sum, average=True)
+
+
+def test_duplicate_name_rejected():
+    # Deterministic version of the reference's duplicate-name check
+    # (common.h:163-166): plant a genuinely in-flight handle, then re-submit.
+    from horovod_tpu.core.state import global_state
+    from horovod_tpu.core.engine import Handle
+
+    eng = global_state().engine
+
+    class NeverReady:
+        def is_ready(self):
+            return False
+
+        def block_until_ready(self):
+            return self
+
+    h = Handle("dup", [NeverReady()], lambda gs: None, eng)
+    eng._track("dup", h)
+    try:
+        with pytest.raises(hvd.DuplicateNameError):
+            hvd.allreduce_async(np.ones((4,), np.float32), name="dup")
+    finally:
+        eng._on_complete(h)
+
+
+def test_completed_name_reusable():
+    # Fire-and-forget reuse: once the device op finishes, the same name must be
+    # accepted again without an explicit synchronize.
+    x = np.ones((8,), np.float32)
+    h1 = hvd.allreduce_async(x, name="reuse")
+    for g in h1._garrs:
+        g.block_until_ready()  # device-side completion only; no user poll
+    h2 = hvd.allreduce_async(x, name="reuse")
+    np.testing.assert_array_equal(np.asarray(hvd.synchronize(h2)), x)
+    hvd.synchronize(h1)
+
+
+def test_allgather_size1():
+    x = np.random.randn(3, 2).astype(np.float32)
+    out = np.asarray(hvd.allgather(x, name="ag1"))
+    np.testing.assert_array_equal(out, x)
+
+
+def test_broadcast_size1():
+    x = np.random.randn(4).astype(np.float32)
+    out = np.asarray(hvd.broadcast(x, root_rank=0, name="bc1"))
+    np.testing.assert_array_equal(out, x)
+
+
+def test_alltoall_size1():
+    x = np.arange(6, dtype=np.float32)
+    # No splits → tensor only (drop-in parity with torch/mpi_ops.py alltoall).
+    out = hvd.alltoall(x, name="a2a1")
+    np.testing.assert_array_equal(np.asarray(out), x)
+    # With splits → (tensor, received_splits).
+    out2, splits = hvd.alltoall(x, splits=[6], name="a2a2")
+    np.testing.assert_array_equal(np.asarray(out2), x)
+    assert np.asarray(splits).tolist() == [6]
+
+
+def test_integer_average_rejected():
+    with pytest.raises(ValueError, match="integer"):
+        hvd.allreduce(np.ones((4,), np.int32), name="int_avg", op=hvd.Average)
+
+
+def test_reducescatter_bad_op_rejected():
+    with pytest.raises(ValueError, match="Sum and Average"):
+        hvd.reducescatter(np.ones((4,), np.float32), name="rs_bad", op=hvd.Min)
+
+
+def test_adasum_eager_size1():
+    # size-1 world: Adasum of a single contribution is the identity.
+    x = np.random.randn(16).astype(np.float32)
+    out = np.asarray(hvd.allreduce(x, name="adasum1", op=hvd.Adasum))
+    np.testing.assert_allclose(out, x, rtol=1e-6)
+    outs = hvd.grouped_allreduce([x, x * 2], name="adasum_grp", op=hvd.Adasum)
+    np.testing.assert_allclose(np.asarray(outs[1]), x * 2, rtol=1e-6)
+
+
+def test_grouped_allreduce():
+    ts = [np.ones((4,), np.float32), np.full((3,), 2.0, np.float32),
+          np.arange(5, dtype=np.float32)]
+    outs = hvd.grouped_allreduce(ts, name="grp1")
+    assert len(outs) == 3
+    for t, o in zip(ts, outs):
+        np.testing.assert_array_equal(np.asarray(o), t)
+
+
+def test_barrier_and_join():
+    hvd.barrier()
+    assert hvd.join() == hvd.size() - 1
+
+
+def test_broadcast_object_and_parameters():
+    obj = {"a": 1, "b": [1, 2, 3]}
+    assert hvd.broadcast_object(obj) == obj
+    params = {"w": jnp.ones((3, 3)), "b": jnp.zeros((3,))}
+    out = hvd.broadcast_parameters(params, root_rank=0)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.ones((3, 3)))
+
+
+def test_allgather_object():
+    assert hvd.allgather_object({"r": 0}) == [{"r": 0}]
