@@ -1,0 +1,95 @@
+"""Benchmark harness — prints ONE JSON line.
+
+Mirrors the reference's synthetic benchmark scripts
+(examples/tensorflow2_synthetic_benchmark.py, pytorch_synthetic_benchmark.py:
+ResNet-50, synthetic ImageNet data, images/sec). Metric: images/sec/chip on
+the available TPU chip(s). Baseline: the reference's only published absolute
+throughput, ResNet-101 synthetic at 1656.82 img/s on 16 Pascal P100s
+(docs/benchmarks.rst:40-46) → 103.55 img/s/GPU; vs_baseline is our
+per-chip ResNet-50 throughput over that number.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+BASELINE_IMG_S_PER_CHIP = 1656.82 / 16.0
+
+
+def main():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.models.resnet import ResNet50
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    # Data-parallel over every visible chip (the reference benchmark is DP
+    # scaling); on a single chip this degenerates to plain jit.
+    n_chips = max(1, len(jax.devices()))
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    data_sh = NamedSharding(mesh, P("data"))
+    rep_sh = NamedSharding(mesh, P())
+
+    batch = int(os.environ.get("BENCH_BATCH", "128")) * n_chips
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    images = jax.device_put(jnp.asarray(
+        np.random.RandomState(0).rand(batch, 224, 224, 3), jnp.float32), data_sh)
+    labels = jax.device_put(jnp.asarray(
+        np.random.RandomState(1).randint(0, 1000, size=(batch,)), jnp.int32),
+        data_sh)
+
+    variables = model.init(rng, images, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    opt = optax.sgd(0.01, momentum=0.9)
+    opt_state = opt.init(params)
+    params, batch_stats, opt_state = jax.device_put(
+        (params, batch_stats, opt_state), rep_sh)
+
+    def loss_fn(params, batch_stats, images, labels):
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats}, images, train=True,
+            mutable=["batch_stats"])
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+        return loss, mutated["batch_stats"]
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, images, labels):
+        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch_stats, images, labels)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_bs, opt_state, loss
+
+    # Warmup / compile
+    for _ in range(3):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels)
+    jax.block_until_ready(loss)
+
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_s = batch * iters / dt
+    img_s_chip = img_s / n_chips
+    print(json.dumps({
+        "metric": "resnet50_synthetic_images_per_sec_per_chip",
+        "value": round(img_s_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_s_chip / BASELINE_IMG_S_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

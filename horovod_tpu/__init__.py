@@ -248,6 +248,9 @@ def join() -> int:
 
 
 # Convenience re-exports
+from . import optimizer  # noqa: E402
+DistributedOptimizer = optimizer.DistributedOptimizer
+from .ops.compression import Compression  # noqa: E402
 from . import functions as _functions  # noqa: E402
 broadcast_parameters = _functions.broadcast_parameters
 broadcast_object = _functions.broadcast_object
@@ -263,6 +266,7 @@ __all__ = [
     "barrier", "join", "poll", "synchronize",
     "broadcast_parameters", "broadcast_object", "allgather_object",
     "broadcast_optimizer_state",
+    "DistributedOptimizer", "Compression", "optimizer",
     "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max", "Product",
     "HorovodInternalError", "HostsUpdatedInterrupt", "DuplicateNameError",
     "__version__",

@@ -1,0 +1,199 @@
+"""Decoder-only transformer LM — the framework's flagship SPMD model.
+
+Demonstrates the parallelism surface the TPU build adds beyond the reference's
+data-parallel-only design (SURVEY.md §2.8): the full train step runs inside one
+``shard_map`` over a (data, seq, tensor) mesh with *explicit* XLA collectives —
+the TPU-native analog of Horovod owning its communication:
+
+- **data**: batch sharded; gradient reduction happens automatically in the
+  backward transpose of replicated-parameter shard_map inputs (the psum the
+  reference implements as NCCLAllreduce on grads).
+- **seq**: sequence sharded; attention runs as ring attention with ppermute
+  K/V rotation (parallel/ring_attention.py).
+- **tensor**: attention heads and MLP hidden dim sharded; partial outputs are
+  psum'd over the axis (Megatron-style TP expressed in lax collectives).
+
+Everything is bfloat16 compute / fp32 params+reductions, static shapes, and
+scan-over-layers for compile-time scaling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.ring_attention import ring_attention_p, local_attention
+
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+TENSOR_AXIS = "tensor"
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 2048
+    max_seq: int = 2048
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def init_params(key, cfg: TransformerConfig):
+    """fp32 master params as a flat dict pytree. Layer params are stacked on a
+    leading n_layers axis so the forward can lax.scan over layers."""
+    k_embed, k_layers, k_out = jax.random.split(key, 3)
+    D, H, Dh, F, L = (cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff,
+                      cfg.n_layers)
+
+    def norm_init(k, shape, fan_in):
+        return jax.random.normal(k, shape, jnp.float32) * (fan_in ** -0.5)
+
+    ks = jax.random.split(k_layers, 6 * L).reshape(L, 6, 2)
+    layers = {
+        "ln1": jnp.ones((L, D), jnp.float32),
+        "wq": jnp.stack([norm_init(ks[i, 0], (D, H, Dh), D) for i in range(L)]),
+        "wk": jnp.stack([norm_init(ks[i, 1], (D, H, Dh), D) for i in range(L)]),
+        "wv": jnp.stack([norm_init(ks[i, 2], (D, H, Dh), D) for i in range(L)]),
+        "wo": jnp.stack([norm_init(ks[i, 3], (H, Dh, D), D) for i in range(L)]),
+        "ln2": jnp.ones((L, D), jnp.float32),
+        "w1": jnp.stack([norm_init(ks[i, 4], (D, F), D) for i in range(L)]),
+        "w2": jnp.stack([norm_init(ks[i, 5], (F, D), F) for i in range(L)]),
+    }
+    return {
+        "embed": norm_init(k_embed, (cfg.vocab_size, D), D) * (D ** 0.5) * 0.02,
+        "layers": layers,
+        "ln_f": jnp.ones((D,), jnp.float32),
+    }
+
+
+def param_specs(cfg: TransformerConfig):
+    """PartitionSpecs over (data, seq, tensor): heads/hidden sharded on tensor,
+    everything replicated over data+seq (their reduction happens in backward)."""
+    return {
+        "embed": P(),
+        "layers": {
+            "ln1": P(), "ln2": P(),
+            "wq": P(None, None, TENSOR_AXIS), "wk": P(None, None, TENSOR_AXIS),
+            "wv": P(None, None, TENSOR_AXIS), "wo": P(None, TENSOR_AXIS),
+            "w1": P(None, None, TENSOR_AXIS), "w2": P(None, TENSOR_AXIS),
+        },
+        "ln_f": P(),
+    }
+
+
+def _rmsnorm(x, scale):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + 1e-6) * scale).astype(x.dtype)
+
+
+def forward_block(params, tokens, cfg: TransformerConfig,
+                  seq_size: Optional[int] = None,
+                  tensor_size: Optional[int] = None, causal: bool = True):
+    """Forward over a *local* token block [B_local, T_local].
+
+    ``seq_size``/``tensor_size`` are the mesh-axis sizes when running inside
+    shard_map (collectives are emitted whenever the axis is manual, even at
+    size 1 — a sharded weight is varying over its axis regardless of size) and
+    ``None`` outside shard_map (single-device path, no collectives).
+    """
+    dt = cfg.dtype
+    h = params["embed"][tokens].astype(dt)  # [B, T, D]
+    Dh = cfg.head_dim
+
+    def layer(h, lp):
+        # Attention
+        x = _rmsnorm(h, lp["ln1"])
+        q = jnp.einsum("btd,dhk->bthk", x, lp["wq"].astype(dt))
+        k = jnp.einsum("btd,dhk->bthk", x, lp["wk"].astype(dt))
+        v = jnp.einsum("btd,dhk->bthk", x, lp["wv"].astype(dt))
+        if seq_size is not None and seq_size > 1:
+            att = ring_attention_p(q, k, v, SEQ_AXIS, seq_size, causal=causal)
+        else:
+            att = local_attention(q, k, v, causal=causal)
+        out = jnp.einsum("bthk,hkd->btd", att, lp["wo"].astype(dt))
+        if tensor_size is not None:
+            out = lax.psum(out, TENSOR_AXIS)
+        h = h + out
+        # MLP
+        x = _rmsnorm(h, lp["ln2"])
+        u = jax.nn.gelu(jnp.einsum("btd,df->btf", x, lp["w1"].astype(dt)))
+        out = jnp.einsum("btf,fd->btd", u, lp["w2"].astype(dt))
+        if tensor_size is not None:
+            out = lax.psum(out, TENSOR_AXIS)
+        h = h + out
+        return h, None
+
+    h, _ = lax.scan(layer, h, params["layers"])
+    h = _rmsnorm(h, params["ln_f"])
+    logits = jnp.einsum("btd,vd->btv", h, params["embed"].astype(dt))
+    return logits.astype(jnp.float32)
+
+
+def _local_loss(params, inputs, targets, cfg, seq_size=None, tensor_size=None):
+    logits = forward_block(params, inputs, cfg, seq_size, tensor_size)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll), nll.size
+
+
+def make_spmd_loss(mesh: Mesh, cfg: TransformerConfig):
+    """Build loss(params, inputs, targets) -> replicated scalar, with the whole
+    computation shard_mapped over the (data, seq, tensor) mesh."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    d_size = sizes.get(DATA_AXIS, 1)
+    s_size = sizes.get(SEQ_AXIS, 1)
+    t_size = sizes.get(TENSOR_AXIS, 1)
+    specs = param_specs(cfg)
+    tok_spec = P(DATA_AXIS, SEQ_AXIS)
+
+    def body(params, inputs, targets):
+        total, count = _local_loss(params, inputs, targets, cfg, s_size, t_size)
+        # Mean over all tokens: psum across batch+sequence shards. (The
+        # backward pass of this psum + the replicated params realizes the
+        # gradient allreduce the reference does explicitly.)
+        total = lax.psum(total, (DATA_AXIS, SEQ_AXIS))
+        n = count * d_size * s_size
+        loss = total / n
+        # tensor axis computes identical values; make that explicit for out_specs
+        return lax.pmean(loss, TENSOR_AXIS)
+
+    return jax.shard_map(body, mesh=mesh, in_specs=(specs, tok_spec, tok_spec),
+                         out_specs=P())
+
+
+def make_train_step(mesh: Mesh, cfg: TransformerConfig, optimizer):
+    """jitted (params, opt_state, inputs, targets) -> (params, opt_state, loss)
+    with dp/sp/tp shardings over ``mesh``."""
+    loss_fn = make_spmd_loss(mesh, cfg)
+
+    def step(params, opt_state, inputs, targets):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, inputs, targets))(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def shard_params(params, mesh: Mesh, cfg: TransformerConfig):
+    """Place a (host or single-device) param pytree onto the mesh per
+    param_specs."""
+    specs = param_specs(cfg)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs,
+        is_leaf=lambda x: isinstance(x, (jnp.ndarray, jax.Array)))

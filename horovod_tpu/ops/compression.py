@@ -1,0 +1,72 @@
+"""Gradient compression (parity: horovod/torch/compression.py:1-74 and
+tensorflow/compression.py — the Compression.none / Compression.fp16 interface).
+
+On TPU the natural wire format is bfloat16 (MXU-native), so a bf16 compressor
+is added alongside the reference's fp16.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Interface: compress returns (compressed_tensor, ctx); decompress inverts."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Compress float tensors to fp16 for the wire, restore original dtype
+    after (reference: torch/compression.py FP16Compressor)."""
+
+    @staticmethod
+    def compress(tensor):
+        ctx = tensor.dtype
+        if jnp.issubdtype(tensor.dtype, jnp.floating):
+            tensor = tensor.astype(jnp.float16)
+        return tensor, ctx
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.astype(ctx) if ctx is not None else tensor
+
+
+class BF16Compressor(Compressor):
+    """TPU-native variant: bfloat16 keeps fp32 range, halves wire bytes."""
+
+    @staticmethod
+    def compress(tensor):
+        ctx = tensor.dtype
+        if jnp.issubdtype(tensor.dtype, jnp.floating):
+            tensor = tensor.astype(jnp.bfloat16)
+        return tensor, ctx
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.astype(ctx) if ctx is not None else tensor
+
+
+class Compression:
+    """Optional gradient compression algorithm used during allreduce
+    (reference naming)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,119 @@
+"""Ring attention: sequence/context parallelism over an ICI mesh axis.
+
+The reference has no sequence parallelism (SURVEY.md §5 long-context: absent),
+but its primitive set — point-to-point neighbor exchange
+(adasum.h:294-305 PointToPointSendRecv) and alltoall — is exactly what SP
+needs. Here we build blockwise ring attention natively: the sequence dimension
+is sharded across the ``seq`` mesh axis; K/V blocks rotate around the ring via
+``lax.ppermute`` (one ICI neighbor hop per step) while each device keeps a
+running flash-attention-style online softmax over its local Q block.
+
+Per-step compute is a [B, H, Tq, Tk] block matmul that XLA tiles onto the MXU;
+the ppermute of the next K/V block overlaps with it (XLA latency-hiding
+scheduler overlaps the collective with the matmul since they have no data
+dependency within a step).
+
+Use inside shard_map with the sequence axis manual; see
+``horovod_tpu.models.transformer`` for the full integration.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _block_scores(q, k, scale):
+    # q: [B, Tq, H, D], k: [B, Tk, H, D] -> [B, H, Tq, Tk]
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                      preferred_element_type=jnp.float32) * scale
+
+
+def ring_attention_p(q, k, v, axis_name: str, axis_size: int,
+                     causal: bool = True):
+    """Blockwise ring attention over mesh axis ``axis_name``.
+
+    Args:
+      q, k, v: local blocks [B, T_local, H, D]; the global sequence is the
+        concatenation of blocks in axis order (block i = ranks i's slice).
+      causal: apply a causal mask over *global* positions.
+
+    Returns local attention output [B, T_local, H, D].
+    """
+    n = axis_size
+    my_block = lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+
+    # Online-softmax accumulators (flash attention recurrence), marked as
+    # varying over the same manual axes as q (at minimum the ring axis) so the
+    # scan carry types line up under shard_map's VMA tracking.
+    try:
+        vma = tuple(jax.typeof(q).vma | {axis_name})
+    except (AttributeError, TypeError):
+        vma = (axis_name,)
+
+    def _vary(x):
+        return lax.pcast(x, vma, to="varying")
+
+    o0 = _vary(jnp.zeros((B, T, H, D), jnp.float32))
+    m0 = _vary(jnp.full((B, H, T), _NEG_INF, jnp.float32))
+    l0 = _vary(jnp.zeros((B, H, T), jnp.float32))
+
+    # K/V travel the ring: after step t, we hold the block of rank
+    # (my_block - t) mod n. perm sends our block to rank+1.
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q_pos = my_block * T + jnp.arange(T)  # global positions of local queries
+
+    def step(carry, t):
+        k_cur, v_cur, o, m, l = carry
+        kv_block = (my_block - t) % n
+        # bf16 operands / f32 accumulation (preferred_element_type) keeps the
+        # QK^T matmul on the MXU bf16 fast path; only o/m/l accumulate in f32.
+        s = _block_scores(q, k_cur, scale)  # [B,H,Tq,Tk] f32
+        if causal:
+            k_pos = kv_block * T + jnp.arange(T)
+            mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_blk = jnp.max(s, axis=-1)                       # [B,H,Tq]
+        m_new = jnp.maximum(m, m_blk)
+        # Guard fully-masked rows: keep exp argument finite.
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+        corr = jnp.exp(m - m_new)
+        corr = jnp.where(m <= _NEG_INF / 2, 0.0, corr)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = (o * corr.transpose(0, 2, 1)[..., None]
+                 + jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_cur.dtype), v_cur,
+                              preferred_element_type=jnp.float32))
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, o_new, m_new, l_new), None
+
+    # lax.scan (not fori_loop) so the ring is reverse-mode differentiable —
+    # the backward pass re-rotates cotangents with the transposed ppermute.
+    (_, _, o, m, l), _ = lax.scan(step, (k, v, o0, m0, l0), jnp.arange(n))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = o / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def local_attention(q, k, v, causal: bool = True):
+    """Single-device reference attention (same layout), for tests and the
+    non-SP path: [B, T, H, D] -> [B, T, H, D]."""
+    B, T, H, D = q.shape
+    s = _block_scores(q, k, 1.0 / math.sqrt(D))  # f32 accumulation
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
