@@ -57,9 +57,13 @@ class Backend:
         if coord and nprocs and int(nprocs) > 1:
             proc_id = int(os.environ.get(env_mod.HOROVOD_TPU_PROCESS_ID,
                                          os.environ.get(env_mod.HOROVOD_RANK, "0")))
+            bind = None
+            if coord == "@rendezvous":
+                coord, bind = self._resolve_coordinator(proc_id)
             jax.distributed.initialize(coordinator_address=coord,
                                        num_processes=int(nprocs),
-                                       process_id=proc_id)
+                                       process_id=proc_id,
+                                       coordinator_bind_address=bind)
             self._distributed = True
         self._rank = jax.process_index()
         self._size = jax.process_count()
@@ -82,6 +86,39 @@ class Backend:
         self._group_sharding = NamedSharding(self._group_mesh, P(WORLD_AXIS))
         self._rep_sharding = NamedSharding(self._group_mesh, P())
         self._initialized = True
+
+    def _resolve_coordinator(self, proc_id: int):
+        """Resolve the ``@rendezvous`` coordinator sentinel.
+
+        The driver can't pick a race-free port on rank 0's host (reference
+        has the same constraint — gloo_context.cc:70-90 solves it with the
+        launcher's HTTP KV). Rank 0 binds a free port locally, publishes
+        ``host:port`` to the rendezvous KV, and binds the coordination
+        service on all interfaces; everyone else long-polls the key.
+        Returns (coordinator_address, coordinator_bind_address|None).
+        """
+        from ..runner.http_client import (put_data_into_kvstore,
+                                          read_data_from_kvstore)
+        rdv_addr = os.environ[env_mod.HOROVOD_GLOO_RENDEZVOUS_ADDR]
+        rdv_port = int(os.environ[env_mod.HOROVOD_GLOO_RENDEZVOUS_PORT])
+        timeout = float(os.environ.get(env_mod.HOROVOD_GLOO_TIMEOUT_SECONDS,
+                                       "120"))
+        if proc_id == 0:
+            from ..runner.http_server import find_free_port
+            port = find_free_port()
+            host = os.environ.get(env_mod.HOROVOD_HOSTNAME, "127.0.0.1")
+            if host in ("localhost", "::1"):
+                host = "127.0.0.1"
+            addr = f"{host}:{port}"
+            put_data_into_kvstore(rdv_addr, rdv_port, "coordinator", "addr",
+                                  addr.encode(), timeout=timeout)
+            # Keep the port reserved only between probe and bind — the same
+            # (small) race the reference accepts; binding on 0.0.0.0 makes
+            # the advertised hostname irrelevant locally.
+            return addr, f"0.0.0.0:{port}"
+        addr = read_data_from_kvstore(rdv_addr, rdv_port, "coordinator",
+                                      "addr", timeout=timeout).decode()
+        return addr, None
 
     def shutdown(self):
         if self._distributed:

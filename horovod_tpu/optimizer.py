@@ -133,9 +133,10 @@ def distributed(inner: optax.GradientTransformation, axis_name: str = "world",
         do_step = count >= backward_passes_per_step
 
         def reduce_and_step(_):
-            avg = jax.tree_util.tree_map(
-                lambda a: a / backward_passes_per_step, accum)
-            reduced = allreduce_gradients(avg, axis_name, op, compression,
+            # Reference semantics (torch/optimizer.py:122-149): grads are
+            # *summed* across the k local passes — only the cross-replica
+            # reduction averages. No /k here.
+            reduced = allreduce_gradients(accum, axis_name, op, compression,
                                           axis_size)
             updates, new_inner = inner.update(reduced, state.inner_state, params)
             zeroed = jax.tree_util.tree_map(jnp.zeros_like, accum)
@@ -225,8 +226,9 @@ class DistributedEagerOptimizer:
             self._count += 1
             if self._count < self.backward_passes_per_step:
                 return params, opt_state
-            grads = jax.tree_util.tree_map(
-                lambda a: a / self.backward_passes_per_step, self._accum)
+            # Summed, not averaged, across local passes (reference
+            # torch/optimizer.py:122-149).
+            grads = self._accum
             self._accum = None
             self._count = 0
         reduced = self.reduce_gradients(grads)

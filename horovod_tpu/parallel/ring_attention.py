@@ -72,8 +72,7 @@ def ring_attention_p(q, k, v, axis_name: str, axis_size: int,
 
     q_pos = my_block * T + jnp.arange(T)  # global positions of local queries
 
-    def step(carry, t):
-        k_cur, v_cur, o, m, l = carry
+    def _accumulate(k_cur, v_cur, o, m, l, t):
         kv_block = (my_block - t) % n
         # bf16 operands / f32 accumulation (preferred_element_type) keeps the
         # QK^T matmul on the MXU bf16 fast path; only o/m/l accumulate in f32.
@@ -93,13 +92,25 @@ def ring_attention_p(q, k, v, axis_name: str, axis_size: int,
         o_new = (o * corr.transpose(0, 2, 1)[..., None]
                  + jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_cur.dtype), v_cur,
                               preferred_element_type=jnp.float32))
+        return o_new, m_new, l_new
+
+    def step(carry, t):
+        k_cur, v_cur, o, m, l = carry
+        o, m, l = _accumulate(k_cur, v_cur, o, m, l, t)
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return (k_nxt, v_nxt, o_new, m_new, l_new), None
+        return (k_nxt, v_nxt, o, m, l), None
 
     # lax.scan (not fori_loop) so the ring is reverse-mode differentiable —
     # the backward pass re-rotates cotangents with the transposed ppermute.
-    (_, _, o, m, l), _ = lax.scan(step, (k, v, o0, m0, l0), jnp.arange(n))
+    # Only n-1 rotations are needed: the last held block is consumed outside
+    # the scan, so no dead ppermute pair rides the hot path.
+    if n > 1:
+        (k_last, v_last, o, m, l), _ = lax.scan(
+            step, (k, v, o0, m0, l0), jnp.arange(n - 1))
+    else:
+        k_last, v_last, o, m, l = k, v, o0, m0, l0
+    o, m, l = _accumulate(k_last, v_last, o, m, l, n - 1)
     l_safe = jnp.where(l == 0.0, 1.0, l)
     out = o / l_safe.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)

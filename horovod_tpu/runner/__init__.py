@@ -1,0 +1,89 @@
+"""Launcher package: ``tpurun`` CLI + programmatic ``run()``.
+
+Parity: reference ``horovod/runner/`` (SURVEY.md §2.5). ``run()`` mirrors
+``horovod.run()`` (reference runner/__init__.py:89): execute a Python function
+on ``np`` distributed worker processes and return the per-rank results in
+rank order.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Any, Callable, Dict, List, Optional
+
+from .hosts import HostInfo, parse_hosts
+from .launch import launch_static
+
+
+def _dumps_payload(fn, args, kwargs) -> bytes:
+    try:
+        import cloudpickle
+    except ImportError:
+        return pickle.dumps((fn, args, kwargs))
+    # Functions from __main__ are pickled by value automatically; functions
+    # from any other non-installed module (e.g. a user script imported under
+    # its file name) must be explicitly registered by value or the worker
+    # will fail to import the module.
+    import sys
+    mod = sys.modules.get(getattr(fn, "__module__", ""))
+    registered = False
+    if mod is not None and getattr(mod, "__name__", "__main__") != "__main__":
+        try:
+            cloudpickle.register_pickle_by_value(mod)
+            registered = True
+        except Exception:
+            pass
+    try:
+        return cloudpickle.dumps((fn, args, kwargs))
+    finally:
+        if registered:
+            cloudpickle.unregister_pickle_by_value(mod)
+
+
+def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
+        np: int = 1, hosts: Optional[str] = None,
+        env: Optional[Dict[str, str]] = None,
+        use_current_interpreter: bool = True,
+        verbose: bool = False) -> List[Any]:
+    """Run ``fn(*args, **kwargs)`` on ``np`` workers; return results by rank.
+
+    Reference semantics (runner/__init__.py:89): the function runs after
+    ``hvd.init()`` on every worker; the returned list has one entry per rank.
+    """
+    kwargs = kwargs or {}
+    host_infos = parse_hosts(hosts) if hosts else [HostInfo("localhost", np)]
+    from .launch import is_local_host
+    remote = [h.hostname for h in host_infos if not is_local_host(h.hostname)]
+    if remote and os.environ.get("HOROVOD_TPU_SHARED_FS") != "1":
+        raise ValueError(
+            f"run() with remote hosts {remote} passes the pickled function "
+            "and collects results through a temporary directory, which must "
+            "be on a filesystem shared by every host. Set "
+            "HOROVOD_TPU_SHARED_FS=1 to acknowledge, or use tpurun with a "
+            "script instead.")
+
+    with tempfile.TemporaryDirectory(prefix="hvd_tpu_run_") as tmp:
+        payload = os.path.join(tmp, "payload.pkl")
+        with open(payload, "wb") as f:
+            f.write(_dumps_payload(fn, args, kwargs))
+        import sys
+        interpreter = sys.executable if use_current_interpreter else "python3"
+        command = [interpreter, "-m", "horovod_tpu.runner.run_task",
+                   payload, tmp]
+        base_env = dict(os.environ)
+        if env:
+            base_env.update(env)
+        launch_static(host_infos, np, command, base_env, verbose=verbose)
+        results = []
+        for rank in range(np):
+            path = os.path.join(tmp, f"result_{rank}.pkl")
+            if not os.path.exists(path):
+                raise RuntimeError(f"rank {rank} produced no result")
+            with open(path, "rb") as f:
+                results.append(pickle.load(f))
+        return results
+
+
+__all__ = ["run", "launch_static", "HostInfo", "parse_hosts"]

@@ -1,0 +1,48 @@
+"""HTTP KV client used by workers to talk to the launcher's rendezvous/KV
+server. Parity: reference ``horovod/runner/http/http_client.py:45``
+(read_data_from_kvstore / put_data_into_kvstore)."""
+
+from __future__ import annotations
+
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+
+def _url(addr: str, port: int, scope: str, key: str) -> str:
+    return f"http://{addr}:{port}/{scope}/{key}"
+
+
+def read_data_from_kvstore(addr: str, port: int, scope: str, key: str,
+                           timeout: float = 60.0,
+                           poll_interval: float = 0.2) -> bytes:
+    """GET with long-poll semantics: retries on 404 until ``timeout``
+    (the reference's workers block until the launcher publishes the key)."""
+    deadline = time.monotonic() + timeout
+    last_err: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    _url(addr, port, scope, key), timeout=timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            last_err = e
+            if e.code != 404:
+                raise
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            last_err = e
+        time.sleep(poll_interval)
+    raise TimeoutError(
+        f"KV store read {scope}/{key} from {addr}:{port} timed out "
+        f"after {timeout}s: {last_err}")
+
+
+def put_data_into_kvstore(addr: str, port: int, scope: str, key: str,
+                          value: bytes, timeout: float = 60.0) -> None:
+    if isinstance(value, str):
+        value = value.encode()
+    req = urllib.request.Request(_url(addr, port, scope, key), data=value,
+                                 method="PUT")
+    with urllib.request.urlopen(req, timeout=timeout):
+        pass

@@ -1,0 +1,151 @@
+"""Threaded HTTP KV store + rendezvous server.
+
+Parity: reference ``horovod/runner/http/http_server.py`` — ``KVStoreHandler``
+GET/PUT (http_server.py:35-110), ``RendezvousHandler`` with per-scope key
+extraction and host-allocation-plan lookup (http_server.py:112-173), and the
+standalone ``KVStoreServer``.
+
+Role in the TPU build: the launcher starts one of these on the driver; workers
+fetch their ``SlotInfo`` (rank/local/cross) and the JAX coordinator address
+from it, and the elastic driver uses the PUT channel for worker address
+registration (reference elastic/rendezvous.py:37-55).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+_LOG = logging.getLogger("horovod_tpu.runner")
+
+OK = 200
+NOT_FOUND = 404
+BAD_REQUEST = 400
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # quiet the default stderr chatter
+    def log_message(self, fmt, *args):
+        _LOG.debug("http: " + fmt, *args)
+
+    def _split(self):
+        parts = self.path.lstrip("/").split("/", 1)
+        scope = parts[0] if parts else ""
+        key = parts[1] if len(parts) > 1 else ""
+        return scope, key
+
+    def do_GET(self):  # noqa: N802
+        scope, key = self._split()
+        value = self.server.handle_get(scope, key, self)
+        if value is None:
+            self.send_response(NOT_FOUND)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(OK)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_PUT(self):  # noqa: N802
+        scope, key = self._split()
+        length = int(self.headers.get("Content-Length", "0"))
+        value = self.rfile.read(length)
+        code = self.server.handle_put(scope, key, value, self)
+        self.send_response(code)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class KVStoreServer(ThreadingHTTPServer):
+    """Plain scoped KV store over HTTP (reference http_server.py:175-242)."""
+
+    daemon_threads = True
+
+    def __init__(self, addr=("0.0.0.0", 0)):
+        super().__init__(addr, _KVHandler)
+        self._lock = threading.Lock()
+        self._store: Dict[str, Dict[str, bytes]] = collections.defaultdict(dict)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- handler callbacks --------------------------------------------------
+
+    def handle_get(self, scope: str, key: str, handler) -> Optional[bytes]:
+        with self._lock:
+            return self._store.get(scope, {}).get(key)
+
+    def handle_put(self, scope: str, key: str, value: bytes, handler) -> int:
+        with self._lock:
+            self._store[scope][key] = value
+        return OK
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        name="kvstore-http", daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        self.shutdown()
+        self.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class RendezvousServer(KVStoreServer):
+    """KV store that additionally answers GET ``/rank_and_size/<host>:<local>``
+    with the worker's colon-joined SlotInfo, and exposes the coordinator
+    address under GET ``/coordinator/addr``.
+
+    Reference: RendezvousHandler scope extraction (http_server.py:112-173).
+    Elastic subclasses override ``handle_get`` to record readiness
+    (elastic/rendezvous.py:37-42).
+    """
+
+    SCOPE_RANK = "rank_and_size"
+    SCOPE_COORD = "coordinator"
+
+    def __init__(self, addr=("0.0.0.0", 0)):
+        super().__init__(addr)
+        self._slots_by_key: Dict[str, "SlotInfo"] = {}
+
+    def init(self, host_assignments, coordinator_addr: Optional[str] = None):
+        """(Re)load the host allocation plan; returns the server port."""
+        from .hosts import SlotInfo  # noqa: F401  (type only)
+        with self._lock:
+            self._slots_by_key = {
+                f"{s.hostname}:{s.local_rank}": s for s in host_assignments}
+            if coordinator_addr is not None:
+                self._store[self.SCOPE_COORD]["addr"] = \
+                    coordinator_addr.encode()
+        return self.port
+
+    def handle_get(self, scope: str, key: str, handler):
+        if scope == self.SCOPE_RANK:
+            with self._lock:
+                slot = self._slots_by_key.get(key)
+            if slot is None:
+                return None
+            return slot.to_response_string().encode()
+        return super().handle_get(scope, key, handler)
+
+
+def find_free_port(bind: str = "") -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind((bind, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

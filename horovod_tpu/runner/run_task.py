@@ -1,0 +1,41 @@
+"""Worker-side entry for the programmatic ``horovod_tpu.run()`` API.
+
+Parity: the reference's ``horovod.runner.run()`` serializes the user function
+and has each worker execute it, collecting per-rank return values
+(runner/__init__.py:89, task_fn wrapping). Here: workers unpickle
+``(fn, args, kwargs)`` from the payload file, ``hvd.init()``, call the fn,
+and write ``result_<rank>.pkl`` into the output dir.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+
+
+def _loads(data: bytes):
+    try:
+        import cloudpickle
+        return cloudpickle.loads(data)
+    except ImportError:
+        return pickle.loads(data)
+
+
+def main(payload_path: str, out_dir: str) -> int:
+    with open(payload_path, "rb") as f:
+        fn, args, kwargs = _loads(f.read())
+    import horovod_tpu as hvd
+    hvd.init()
+    try:
+        result = fn(*args, **kwargs)
+        rank = hvd.rank()
+        with open(os.path.join(out_dir, f"result_{rank}.pkl"), "wb") as f:
+            pickle.dump(result, f)
+    finally:
+        hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], sys.argv[2]))

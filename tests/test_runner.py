@@ -1,0 +1,150 @@
+"""Launcher unit tests (no processes spawned, mirrors reference
+test/test_run.py:906 mocked-launcher tier)."""
+
+import os
+
+import pytest
+
+from horovod_tpu.runner.hosts import (HostInfo, SlotInfo, get_host_assignments,
+                                      parse_hosts, parse_host_files)
+from horovod_tpu.runner.http_server import KVStoreServer, RendezvousServer
+from horovod_tpu.runner.http_client import (put_data_into_kvstore,
+                                            read_data_from_kvstore)
+from horovod_tpu.runner import launch
+from horovod_tpu.common import env as env_mod
+
+
+class TestHosts:
+    def test_parse_hosts(self):
+        hosts = parse_hosts("a:2, b:4,c")
+        assert hosts == [HostInfo("a", 2), HostInfo("b", 4), HostInfo("c", 1)]
+
+    def test_parse_host_files(self, tmp_path):
+        f = tmp_path / "hostfile"
+        f.write_text("a slots=2\n# comment\nb:3\nc\n")
+        assert parse_host_files(str(f)) == [
+            HostInfo("a", 2), HostInfo("b", 3), HostInfo("c", 1)]
+
+    def test_assignments_single_host(self):
+        slots = get_host_assignments([HostInfo("localhost", 4)], 4)
+        assert [s.rank for s in slots] == [0, 1, 2, 3]
+        assert [s.local_rank for s in slots] == [0, 1, 2, 3]
+        assert all(s.size == 4 and s.local_size == 4 for s in slots)
+        assert all(s.cross_rank == 0 and s.cross_size == 1 for s in slots)
+
+    def test_assignments_two_hosts(self):
+        slots = get_host_assignments(
+            [HostInfo("h1", 2), HostInfo("h2", 2)], 4)
+        # host-major rank order, contiguous local ranks
+        assert [(s.hostname, s.rank, s.local_rank) for s in slots] == [
+            ("h1", 0, 0), ("h1", 1, 1), ("h2", 2, 0), ("h2", 3, 1)]
+        # cross topology: rank0/rank2 share local_rank 0 across hosts
+        assert slots[0].cross_rank == 0 and slots[2].cross_rank == 1
+        assert all(s.cross_size == 2 for s in slots)
+
+    def test_assignments_insufficient_slots(self):
+        with pytest.raises(ValueError):
+            get_host_assignments([HostInfo("h1", 1)], 2)
+
+    def test_assignments_capped_max(self):
+        slots = get_host_assignments(
+            [HostInfo("h1", 4), HostInfo("h2", 4)], 2, max_np=3)
+        assert len(slots) == 3
+        assert [s.hostname for s in slots] == ["h1", "h1", "h1"]
+
+    def test_slotinfo_roundtrip(self):
+        s = SlotInfo("host-a", 3, 1, 2, 8, 4, 2)
+        assert SlotInfo.from_response_string(s.to_response_string()) == s
+
+
+class TestKVStore:
+    def test_put_get(self):
+        server = KVStoreServer(("127.0.0.1", 0))
+        port = server.start()
+        try:
+            put_data_into_kvstore("127.0.0.1", port, "scope", "k", b"v1")
+            assert read_data_from_kvstore("127.0.0.1", port, "scope", "k",
+                                          timeout=5) == b"v1"
+        finally:
+            server.stop()
+
+    def test_get_missing_times_out(self):
+        server = KVStoreServer(("127.0.0.1", 0))
+        port = server.start()
+        try:
+            with pytest.raises(TimeoutError):
+                read_data_from_kvstore("127.0.0.1", port, "scope", "nope",
+                                       timeout=0.5, poll_interval=0.1)
+        finally:
+            server.stop()
+
+    def test_rendezvous_slot_lookup(self):
+        slots = get_host_assignments(
+            [HostInfo("h1", 2), HostInfo("h2", 2)], 4)
+        server = RendezvousServer(("127.0.0.1", 0))
+        port = server.start()
+        try:
+            server.init(slots, "10.0.0.1:1234")
+            raw = read_data_from_kvstore("127.0.0.1", port,
+                                         "rank_and_size", "h2:1", timeout=5)
+            got = SlotInfo.from_response_string(raw.decode())
+            assert (got.rank, got.local_rank, got.cross_rank) == (3, 1, 1)
+            coord = read_data_from_kvstore("127.0.0.1", port,
+                                           "coordinator", "addr", timeout=5)
+            assert coord == b"10.0.0.1:1234"
+        finally:
+            server.stop()
+
+
+class TestLaunchCLI:
+    def test_worker_env(self):
+        slot = SlotInfo("localhost", 1, 1, 0, 2, 2, 1)
+        env = launch.make_worker_env(slot, "127.0.0.1:999", "127.0.0.1", 888,
+                                     base_env={})
+        assert env[env_mod.HOROVOD_RANK] == "1"
+        assert env[env_mod.HOROVOD_SIZE] == "2"
+        assert env[env_mod.HOROVOD_LOCAL_RANK] == "1"
+        assert env[env_mod.HOROVOD_TPU_COORDINATOR] == "127.0.0.1:999"
+        assert env[env_mod.HOROVOD_TPU_PROCESS_ID] == "1"
+        assert env[env_mod.HOROVOD_GLOO_RENDEZVOUS_PORT] == "888"
+
+    def test_slot_command_local_vs_ssh(self):
+        local = SlotInfo("localhost", 0, 0, 0, 2, 1, 1)
+        remote = SlotInfo("farhost", 1, 0, 1, 2, 1, 2)
+        env = {"HOROVOD_RANK": "1", "SECRET_TOKEN": "x"}
+        cmd_local = launch.slot_command(["python", "train.py"], env, local)
+        assert cmd_local == "python train.py"
+        cmd_remote = launch.slot_command(["python", "train.py"], env, remote)
+        assert cmd_remote.startswith("ssh ")
+        assert "farhost" in cmd_remote
+        assert "HOROVOD_RANK=1" in cmd_remote
+        # non-allowlisted env must not leak over ssh
+        assert "SECRET_TOKEN" not in cmd_remote
+
+    def test_parse_args_static(self):
+        args = launch.parse_args(
+            ["-np", "4", "-H", "a:2,b:2", "--timeline-filename", "/tmp/t.json",
+             "--autotune", "--", "python", "train.py"])
+        assert args.num_proc == 4 and args.hosts == "a:2,b:2"
+        env = launch.env_from_args(args)
+        assert env[env_mod.HOROVOD_TIMELINE] == "/tmp/t.json"
+        assert env[env_mod.HOROVOD_AUTOTUNE] == "1"
+        assert args.command == ["--", "python", "train.py"]
+
+    def test_parse_args_config_file(self, tmp_path):
+        cfg = tmp_path / "cfg.yaml"
+        cfg.write_text("num-proc: 2\ntuning:\n  cycle-time-ms: 3.5\n")
+        args = launch.parse_args(["--config-file", str(cfg), "python", "x.py"])
+        assert args.num_proc == 2
+        assert args.cycle_time_ms == 3.5
+        env = launch.env_from_args(args)
+        assert env[env_mod.HOROVOD_CYCLE_TIME] == "3.5"
+
+    def test_main_requires_command(self, capsys):
+        assert launch.main(["-np", "2"]) == 2
+
+    def test_fusion_threshold_env(self):
+        args = launch.parse_args(["-np", "1", "--fusion-threshold-mb", "32",
+                                  "x"])
+        env = launch.env_from_args(args)
+        assert env[env_mod.HOROVOD_FUSION_THRESHOLD] == str(32 * 1024 * 1024)
