@@ -256,6 +256,7 @@ broadcast_parameters = _functions.broadcast_parameters
 broadcast_object = _functions.broadcast_object
 allgather_object = _functions.allgather_object
 broadcast_optimizer_state = _functions.broadcast_optimizer_state
+from . import elastic  # noqa: E402
 
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
@@ -266,7 +267,7 @@ __all__ = [
     "barrier", "join", "poll", "synchronize",
     "broadcast_parameters", "broadcast_object", "allgather_object",
     "broadcast_optimizer_state",
-    "DistributedOptimizer", "Compression", "optimizer",
+    "DistributedOptimizer", "Compression", "optimizer", "elastic",
     "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max", "Product",
     "HorovodInternalError", "HostsUpdatedInterrupt", "DuplicateNameError",
     "__version__",

@@ -1,0 +1,12 @@
+"""Bayesian autotuning of runtime knobs.
+
+Parity: reference ``horovod/common/parameter_manager.{h,cc}`` +
+``horovod/common/optim/`` (Gaussian process + expected improvement).
+"""
+
+from .gaussian_process import GaussianProcessRegressor
+from .bayesian_optimization import BayesianOptimizer, expected_improvement
+from .parameter_manager import ParameterManager
+
+__all__ = ["GaussianProcessRegressor", "BayesianOptimizer",
+           "expected_improvement", "ParameterManager"]

@@ -1,0 +1,100 @@
+"""Bayesian optimization (GP + expected improvement) for the autotuner.
+
+Parity: reference ``horovod/common/optim/bayesian_optimization.{h,cc}``
+(expected-improvement acquisition over a GP posterior, maximized with LBFGS
+restarts; here maximized over dense random candidates — the search space is
+2-4 dims and tiny, so candidate sampling is both simpler and as effective).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .gaussian_process import GaussianProcessRegressor
+
+
+def _norm_cdf(z: np.ndarray) -> np.ndarray:
+    return 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2.0)))
+
+
+def _norm_pdf(z: np.ndarray) -> np.ndarray:
+    return np.exp(-0.5 * z ** 2) / math.sqrt(2.0 * math.pi)
+
+
+def expected_improvement(mean: np.ndarray, std: np.ndarray, best_y: float,
+                         xi: float = 0.01) -> np.ndarray:
+    """EI(x) = (μ - y* - ξ)Φ(z) + σφ(z), z = (μ - y* - ξ)/σ."""
+    imp = mean - best_y - xi
+    with np.errstate(divide="ignore", invalid="ignore"):
+        z = np.where(std > 0, imp / std, 0.0)
+    ei = imp * _norm_cdf(z) + std * _norm_pdf(z)
+    return np.where(std > 1e-12, ei, np.maximum(imp, 0.0))
+
+
+class BayesianOptimizer:
+    """Maximize an expensive black-box score over a box-bounded space.
+
+    Usage (mirrors the reference's ParameterManager loop):
+    ``suggest()`` → try the returned point → ``register(x, y)`` → repeat.
+    """
+
+    def __init__(self, bounds: Sequence[Tuple[float, float]],
+                 n_candidates: int = 2000, xi: float = 0.01,
+                 seed: int = 0, noise: float = 1e-6):
+        self.bounds = np.asarray(bounds, dtype=np.float64)  # (d, 2)
+        self.dim = len(self.bounds)
+        self.n_candidates = n_candidates
+        self.xi = xi
+        self._rng = np.random.RandomState(seed)
+        self._xs: List[np.ndarray] = []
+        self._ys: List[float] = []
+        self._gp = GaussianProcessRegressor(alpha=noise)
+
+    # -- sample bookkeeping -------------------------------------------------
+
+    def register(self, x: Sequence[float], y: float):
+        self._xs.append(np.asarray(x, dtype=np.float64))
+        self._ys.append(float(y))
+
+    @property
+    def n_samples(self) -> int:
+        return len(self._ys)
+
+    def best(self) -> Tuple[Optional[np.ndarray], float]:
+        if not self._ys:
+            return None, -np.inf
+        i = int(np.argmax(self._ys))
+        return self._xs[i], self._ys[i]
+
+    # -- suggestion ---------------------------------------------------------
+
+    def _normalize(self, x: np.ndarray) -> np.ndarray:
+        lo, hi = self.bounds[:, 0], self.bounds[:, 1]
+        return (x - lo) / np.maximum(hi - lo, 1e-12)
+
+    def _denormalize(self, u: np.ndarray) -> np.ndarray:
+        lo, hi = self.bounds[:, 0], self.bounds[:, 1]
+        return lo + u * (hi - lo)
+
+    def suggest(self) -> np.ndarray:
+        """Next point to evaluate: EI-argmax over random candidates (plus the
+        incumbent's neighborhood); random until 3 samples exist."""
+        if self.n_samples < 3:
+            return self._denormalize(self._rng.rand(self.dim))
+        xs = self._normalize(np.stack(self._xs))
+        ys = np.asarray(self._ys)
+        # normalize scores for GP conditioning
+        y_mean, y_std = ys.mean(), max(ys.std(), 1e-12)
+        self._gp.fit(xs, (ys - y_mean) / y_std)
+        cand = self._rng.rand(self.n_candidates, self.dim)
+        # local perturbations of the incumbent sharpen the search
+        best_u = xs[int(np.argmax(ys))]
+        local = np.clip(best_u + 0.05 * self._rng.randn(200, self.dim), 0, 1)
+        cand = np.vstack([cand, local])
+        mean, std = self._gp.predict(cand)
+        ei = expected_improvement(mean, std, float(((ys.max() - y_mean) /
+                                                    y_std)), self.xi)
+        return self._denormalize(cand[int(np.argmax(ei))])

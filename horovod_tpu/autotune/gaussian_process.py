@@ -1,0 +1,96 @@
+"""Gaussian-process regression for the autotuner.
+
+Parity: reference ``horovod/common/optim/gaussian_process.{h,cc}`` (Eigen
+implementation of an RBF-kernel GP with measurement noise, used by the
+Bayesian parameter tuner). Re-implemented on NumPy — same math: RBF kernel
+with length-scale ``l`` and signal variance ``sigma_f²``, diagonal noise
+``alpha``, posterior mean/variance via Cholesky solves.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class GaussianProcessRegressor:
+    def __init__(self, length_scale: float = 1.0, sigma_f: float = 1.0,
+                 alpha: float = 1e-8):
+        self.length_scale = float(length_scale)
+        self.sigma_f = float(sigma_f)
+        self.alpha = float(alpha)
+        self._x: Optional[np.ndarray] = None
+        self._y: Optional[np.ndarray] = None
+        self._chol: Optional[np.ndarray] = None
+        self._weights: Optional[np.ndarray] = None
+
+    # -- kernel -------------------------------------------------------------
+
+    def kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        """Isotropic RBF: sigma_f² · exp(-‖a-b‖²/(2l²))."""
+        a = np.atleast_2d(a)
+        b = np.atleast_2d(b)
+        sq = (np.sum(a ** 2, axis=1)[:, None] + np.sum(b ** 2, axis=1)[None, :]
+              - 2.0 * a @ b.T)
+        sq = np.maximum(sq, 0.0)
+        return (self.sigma_f ** 2) * np.exp(-0.5 * sq /
+                                            (self.length_scale ** 2))
+
+    # -- fit / predict ------------------------------------------------------
+
+    def fit(self, x: np.ndarray, y: np.ndarray,
+            optimize_hyperparams: bool = True):
+        """Fit to samples; optionally pick (length_scale, sigma_f) by grid
+        search over the log marginal likelihood (the reference runs LBFGS on
+        the same objective — a coarse grid is robust and dependency-free)."""
+        self._x = np.atleast_2d(np.asarray(x, dtype=np.float64))
+        self._y = np.asarray(y, dtype=np.float64).reshape(-1)
+        if optimize_hyperparams and len(self._y) >= 3:
+            self._optimize_hyperparams()
+        self._refit()
+        return self
+
+    def _refit(self):
+        k = self.kernel(self._x, self._x)
+        k[np.diag_indices_from(k)] += self.alpha
+        self._chol = np.linalg.cholesky(k)
+        self._weights = np.linalg.solve(
+            self._chol.T, np.linalg.solve(self._chol, self._y))
+
+    def _log_marginal_likelihood(self) -> float:
+        try:
+            self._refit()
+        except np.linalg.LinAlgError:
+            return -np.inf
+        n = len(self._y)
+        return float(-0.5 * self._y @ self._weights
+                     - np.sum(np.log(np.diag(self._chol)))
+                     - 0.5 * n * np.log(2 * np.pi))
+
+    def _optimize_hyperparams(self):
+        y_std = max(float(np.std(self._y)), 1e-6)
+        spread = np.ptp(self._x, axis=0)
+        scale0 = max(float(np.max(spread)), 1e-3)
+        best = (-np.inf, self.length_scale, self.sigma_f)
+        for ls in scale0 * np.array([0.1, 0.25, 0.5, 1.0, 2.0]):
+            for sf in y_std * np.array([0.5, 1.0, 2.0]):
+                self.length_scale, self.sigma_f = float(ls), float(sf)
+                lml = self._log_marginal_likelihood()
+                if lml > best[0]:
+                    best = (lml, self.length_scale, self.sigma_f)
+        _, self.length_scale, self.sigma_f = best
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Posterior (mean, std) at query points."""
+        x = np.atleast_2d(np.asarray(x, dtype=np.float64))
+        if self._x is None or len(self._y) == 0:
+            return np.zeros(len(x)), np.full(len(x), self.sigma_f)
+        ks = self.kernel(x, self._x)                      # (q, n)
+        mean = ks @ self._weights
+        v = np.linalg.solve(self._chol, ks.T)             # (n, q)
+        var = self.kernel_diag(x) - np.sum(v ** 2, axis=0)
+        return mean, np.sqrt(np.maximum(var, 1e-12))
+
+    def kernel_diag(self, x: np.ndarray) -> np.ndarray:
+        return np.full(len(np.atleast_2d(x)), self.sigma_f ** 2)

@@ -1,0 +1,148 @@
+"""Autotuning parameter manager.
+
+Parity: reference ``horovod/common/parameter_manager.{h,cc}`` — tunes the
+fusion/bucket threshold and cycle time by Bayesian optimization
+(parameter_manager.h:178-220), scores candidates by observed throughput in
+bytes/sec (:80-88), discards warmup samples and averages several scores per
+candidate (:234-241), and converges to the best-seen configuration. The
+winning parameters are broadcast from rank 0 so every worker agrees
+(controller.cc:34-48 SynchronizeParameters) — here scoring inputs are already
+identical on every rank (SPMD), but we keep the broadcast for the eager path
+where ranks may measure slightly different wall-clock.
+
+Tuned knobs (log₂-scaled, like the reference's NumericParameter scaling):
+- fusion_threshold_bytes ∈ [1 MB, 256 MB]
+- cycle_time_ms ∈ [1, 25]
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from .bayesian_optimization import BayesianOptimizer
+
+_LOG = logging.getLogger("horovod_tpu.autotune")
+
+MB = 1024 * 1024
+
+
+class ParameterManager:
+    WARMUPS = 3            # HOROVOD_AUTOTUNE_WARMUP_SAMPLES default (h:234)
+    CYCLES_PER_SAMPLE = 10  # steps averaged per candidate (h:238)
+    MAX_SAMPLES = 20       # BAYES_OPT_MAX_SAMPLES: stop tuning after this
+
+    def __init__(self, warmup_samples: int = WARMUPS,
+                 steps_per_sample: int = CYCLES_PER_SAMPLE,
+                 max_samples: int = MAX_SAMPLES,
+                 gp_noise: float = 0.8,
+                 initial_threshold: int = 64 * MB,
+                 initial_cycle_ms: float = 5.0,
+                 log_path: Optional[str] = None,
+                 bcast_object: Optional[Callable] = None):
+        # search space in log2 units
+        self._bounds = [(np.log2(1 * MB), np.log2(256 * MB)),
+                        (np.log2(1.0), np.log2(25.0))]
+        self._opt = BayesianOptimizer(self._bounds, noise=gp_noise)
+        self._warmup_remaining = warmup_samples
+        self._steps_per_sample = steps_per_sample
+        self._max_samples = max_samples
+        self._bcast_object = bcast_object
+
+        self._active = True
+        self._current = np.array([np.log2(initial_threshold),
+                                  np.log2(initial_cycle_ms)])
+        self._scores: List[float] = []
+        self._step_bytes = 0
+        self._step_start: Optional[float] = None
+        self._log_path = log_path
+        self._log_file = open(log_path, "w") if log_path else None
+        if self._log_file:
+            self._log_file.write(
+                "sample,fusion_threshold_bytes,cycle_time_ms,score_bytes_per_sec\n")
+
+    # -- public knob values --------------------------------------------------
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    @property
+    def fusion_threshold_bytes(self) -> int:
+        return int(2 ** self._current[0])
+
+    @property
+    def cycle_time_ms(self) -> float:
+        return float(2 ** self._current[1])
+
+    @property
+    def n_samples_taken(self) -> int:
+        return self._opt.n_samples
+
+    # -- scoring loop --------------------------------------------------------
+
+    def step_mark(self, nbytes: int):
+        """Mark the start of a training step that will move ``nbytes`` of
+        gradient traffic. Called at grouped-allreduce entry — a point every
+        rank reaches in the same program order, so the (collective) parameter
+        sync below is ordered identically everywhere. The interval between
+        successive marks is the step time; score = bytes/sec over it (the
+        reference's cycle scoring, parameter_manager.h:80-88)."""
+        if not self._active:
+            return
+        now = time.perf_counter()
+        if self._step_start is not None and self._step_bytes > 0:
+            elapsed = now - self._step_start
+            if elapsed > 0:
+                self._scores.append(self._step_bytes / elapsed)
+                if len(self._scores) >= self._steps_per_sample:
+                    score = float(np.mean(self._scores))
+                    self._scores = []
+                    self._on_sample(score)
+        self._step_start = time.perf_counter()
+        self._step_bytes = nbytes
+
+    def _on_sample(self, score: float):
+        if self._warmup_remaining > 0:
+            self._warmup_remaining -= 1
+            return
+        self._opt.register(self._current.copy(), score)
+        if self._log_file:
+            self._log_file.write(
+                f"{self._opt.n_samples},{self.fusion_threshold_bytes},"
+                f"{self.cycle_time_ms:.3f},{score:.1f}\n")
+            self._log_file.flush()
+        if self._opt.n_samples >= self._max_samples:
+            best_x, best_y = self._opt.best()
+            self._current = np.asarray(best_x)
+            self._active = False
+            self._sync_params()
+            _LOG.info(
+                "autotune converged: fusion=%d MB cycle=%.1f ms "
+                "(%.1f MB/s)", self.fusion_threshold_bytes // MB,
+                self.cycle_time_ms, best_y / MB)
+            if self._log_file:
+                self._log_file.write(
+                    f"best,{self.fusion_threshold_bytes},"
+                    f"{self.cycle_time_ms:.3f},{best_y:.1f}\n")
+                self._log_file.flush()
+                self._log_file.close()
+                self._log_file = None
+        else:
+            self._current = np.asarray(self._opt.suggest())
+            self._sync_params()
+
+    def _sync_params(self):
+        """Agree on parameters across ranks (controller.cc:34-48): rank 0's
+        choice wins."""
+        if self._bcast_object is not None:
+            self._current = np.asarray(self._bcast_object(
+                self._current.tolist(), name="autotune.params"))
+
+    def close(self):
+        if self._log_file:
+            self._log_file.close()
+            self._log_file = None

@@ -1,0 +1,228 @@
+"""Training-loop callbacks.
+
+Parity: reference ``horovod/_keras/callbacks.py`` —
+``BroadcastGlobalVariablesCallback`` (:22), ``MetricAverageCallback`` (:48),
+``LearningRateScheduleCallback`` / ``LearningRateWarmupCallback`` (:90-186)
+— and ``keras/callbacks.py:157`` ``BestModelCheckpoint``.
+
+The TPU-native training loop is functional (params/opt_state pytrees), so
+callbacks operate on a mutable ``TrainLoopState`` the loop owns.  The LR
+callbacks control an ``lr_scale`` multiplier which the optimizer factory
+consumes via :func:`scaled_schedule` — the same mechanism as the reference's
+backend.set_value(model.optimizer.lr, ...).
+"""
+
+from __future__ import annotations
+
+import math
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class TrainLoopState:
+    """Mutable view of the training loop the callbacks act on."""
+    params: Any = None
+    opt_state: Any = None
+    epoch: int = 0
+    lr_scale: float = 1.0          # multiplier consumed by scaled_schedule
+    stop_training: bool = False
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+def scaled_schedule(base_schedule, loop_state: TrainLoopState):
+    """Wrap an optax schedule (or float) so the callbacks' ``lr_scale``
+    multiplier applies. NOTE: the scale is read at trace time only if you
+    re-jit; pass it as a step-input for fully dynamic control."""
+    def sched(count):
+        base = base_schedule(count) if callable(base_schedule) else base_schedule
+        return base * loop_state.lr_scale
+    return sched
+
+
+class Callback:
+    def on_train_begin(self, state: TrainLoopState):
+        pass
+
+    def on_epoch_begin(self, state: TrainLoopState):
+        pass
+
+    def on_epoch_end(self, state: TrainLoopState, logs: Dict[str, float]):
+        pass
+
+    def on_batch_begin(self, state: TrainLoopState, batch: int):
+        pass
+
+    def on_batch_end(self, state: TrainLoopState, batch: int,
+                     logs: Optional[Dict[str, float]] = None):
+        pass
+
+
+class CallbackList(Callback):
+    def __init__(self, callbacks: List[Callback]):
+        self.callbacks = list(callbacks)
+
+    def on_train_begin(self, state):
+        for c in self.callbacks:
+            c.on_train_begin(state)
+
+    def on_epoch_begin(self, state):
+        for c in self.callbacks:
+            c.on_epoch_begin(state)
+
+    def on_epoch_end(self, state, logs):
+        for c in self.callbacks:
+            c.on_epoch_end(state, logs)
+
+    def on_batch_begin(self, state, batch):
+        for c in self.callbacks:
+            c.on_batch_begin(state, batch)
+
+    def on_batch_end(self, state, batch, logs=None):
+        for c in self.callbacks:
+            c.on_batch_end(state, batch, logs)
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast initial params/opt_state from ``root_rank`` at train start
+    (reference _keras/callbacks.py:22-46; tensorflow/__init__.py:187
+    BroadcastGlobalVariablesHook)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, state):
+        from . import functions
+        if state.params is not None:
+            state.params = functions.broadcast_parameters(
+                state.params, root_rank=self.root_rank)
+        if state.opt_state is not None:
+            state.opt_state = functions.broadcast_parameters(
+                state.opt_state, root_rank=self.root_rank)
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch metrics over all ranks before reporting (reference
+    _keras/callbacks.py:48-87)."""
+
+    def on_epoch_end(self, state, logs):
+        import horovod_tpu as hvd
+        if not logs or hvd.size() == 1:
+            return
+        keys = sorted(logs.keys())
+        vec = np.asarray([float(logs[k]) for k in keys], np.float64)
+        out = np.asarray(hvd.allreduce(
+            vec, name=f"metric_avg.e{state.epoch}", op=hvd.Average))
+        for k, v in zip(keys, out):
+            logs[k] = float(v)
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply LR by ``multiplier(epoch)`` within [start_epoch, end_epoch)
+    (reference _keras/callbacks.py:90-155)."""
+
+    def __init__(self, multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True,
+                 steps_per_epoch: Optional[int] = None):
+        self.multiplier = multiplier if callable(multiplier) \
+            else (lambda epoch: multiplier)
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self._batch = 0
+
+    def _in_range(self, epoch) -> bool:
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def on_epoch_begin(self, state):
+        self._batch = 0
+        if self.staircase and self._in_range(state.epoch):
+            state.lr_scale = float(self.multiplier(state.epoch))
+
+    def on_batch_begin(self, state, batch):
+        if not self.staircase and self.steps_per_epoch and \
+                self._in_range(state.epoch):
+            frac = state.epoch + batch / self.steps_per_epoch
+            state.lr_scale = float(self.multiplier(frac))
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Ramp LR from lr/size up to lr over ``warmup_epochs`` (the gradual
+    warmup of Goyal et al. the reference implements,
+    _keras/callbacks.py:158-186): multiplier(epoch) =
+    1/size · (epoch·(size-1)/warmup + 1)."""
+
+    def __init__(self, warmup_epochs: float = 5.0, momentum_correction=None,
+                 steps_per_epoch: Optional[int] = None, verbose: bool = False,
+                 size: Optional[int] = None):
+        def multiplier(epoch):
+            if size is None:
+                import horovod_tpu as hvd
+                world = hvd.size()
+            else:
+                world = size
+            if warmup_epochs <= 0:
+                return 1.0
+            frac = min(float(epoch) / warmup_epochs, 1.0)
+            return (1.0 / world) * (frac * (world - 1) + 1.0)
+        super().__init__(multiplier, start_epoch=0,
+                         end_epoch=math.ceil(warmup_epochs) + 1,
+                         staircase=steps_per_epoch is None,
+                         steps_per_epoch=steps_per_epoch)
+
+
+class BestModelCheckpoint(Callback):
+    """Save params when the monitored metric improves, on rank 0 only
+    (reference keras/callbacks.py:157 BestModelCheckpoint)."""
+
+    def __init__(self, filepath: str, monitor: str = "val_loss",
+                 mode: str = "min"):
+        self.filepath = filepath
+        self.monitor = monitor
+        self.mode = mode
+        self.best: Optional[float] = None
+
+    def _improved(self, value: float) -> bool:
+        if self.best is None:
+            return True
+        return value < self.best if self.mode == "min" else value > self.best
+
+    def on_epoch_end(self, state, logs):
+        import horovod_tpu as hvd
+        import jax
+        if hvd.rank() != 0 or not logs or self.monitor not in logs:
+            return
+        value = float(logs[self.monitor])
+        if self._improved(value):
+            self.best = value
+            host_tree = jax.device_get(state.params)
+            with open(self.filepath, "wb") as f:
+                pickle.dump({"params": host_tree, "epoch": state.epoch,
+                             self.monitor: value}, f)
+
+
+class CommitStateCallback(Callback):
+    """Commit an elastic ``State`` every ``batches_per_commit`` batches
+    (reference _keras/elastic.py:25-44 CommitStateCallbackImpl)."""
+
+    def __init__(self, elastic_state, batches_per_commit: int = 1):
+        self.elastic_state = elastic_state
+        self.batches_per_commit = batches_per_commit
+
+    def on_batch_end(self, state, batch, logs=None):
+        if (batch + 1) % self.batches_per_commit == 0:
+            self.elastic_state.commit()
+
+
+__all__ = [
+    "TrainLoopState", "Callback", "CallbackList", "scaled_schedule",
+    "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
+    "LearningRateScheduleCallback", "LearningRateWarmupCallback",
+    "BestModelCheckpoint", "CommitStateCallback",
+]

@@ -21,6 +21,13 @@ class HostsUpdatedInterrupt(RuntimeError):
         self.skip_sync = skip_sync
 
 
+class WorkerRemovedError(RuntimeError):
+    """This worker's slot no longer exists in the elastic job (its host was
+    scaled away). The elastic run loop exits cleanly on this (reference:
+    gloo_context.cc:157-204 throws when the host is removed from the
+    rendezvous plan)."""
+
+
 class TensorShapeMismatchError(ValueError):
     """Cross-rank shape disagreement (reference surfaces these as ERROR
     responses built in controller.cc:380-623)."""

@@ -52,6 +52,16 @@ class Backend:
     def init(self):
         if self._initialized:
             return
+        slot = None
+        if os.environ.get(env_mod.HOROVOD_ELASTIC):
+            # Elastic worker: identity is (hostname, local_rank); the global
+            # rank/size come from the rendezvous *every* init, so a reset
+            # (shutdown+init) re-joins the new world — reference
+            # gloo_context.cc:157-204 elastic re-init.
+            slot = self._fetch_elastic_slot()
+            os.environ[env_mod.HOROVOD_TPU_NUM_PROCESSES] = str(slot.size)
+            os.environ[env_mod.HOROVOD_TPU_PROCESS_ID] = str(slot.rank)
+            os.environ[env_mod.HOROVOD_RANK] = str(slot.rank)
         coord = os.environ.get(env_mod.HOROVOD_TPU_COORDINATOR)
         nprocs = os.environ.get(env_mod.HOROVOD_TPU_NUM_PROCESSES)
         if coord and nprocs and int(nprocs) > 1:
@@ -67,12 +77,18 @@ class Backend:
             self._distributed = True
         self._rank = jax.process_index()
         self._size = jax.process_count()
-        self._local_rank = int(os.environ.get(env_mod.HOROVOD_LOCAL_RANK, "0"))
-        self._local_size = int(os.environ.get(env_mod.HOROVOD_LOCAL_SIZE, "1"))
-        self._cross_rank = int(os.environ.get(env_mod.HOROVOD_CROSS_RANK,
-                                              str(self._rank // max(self._local_size, 1))))
-        self._cross_size = int(os.environ.get(env_mod.HOROVOD_CROSS_SIZE,
-                                              str(max(1, self._size // max(self._local_size, 1)))))
+        if slot is not None:
+            self._local_rank = slot.local_rank
+            self._local_size = slot.local_size
+            self._cross_rank = slot.cross_rank
+            self._cross_size = slot.cross_size
+        else:
+            self._local_rank = int(os.environ.get(env_mod.HOROVOD_LOCAL_RANK, "0"))
+            self._local_size = int(os.environ.get(env_mod.HOROVOD_LOCAL_SIZE, "1"))
+            self._cross_rank = int(os.environ.get(env_mod.HOROVOD_CROSS_RANK,
+                                                  str(self._rank // max(self._local_size, 1))))
+            self._cross_size = int(os.environ.get(env_mod.HOROVOD_CROSS_SIZE,
+                                                  str(max(1, self._size // max(self._local_size, 1)))))
         # One device per process for the eager group mesh. Pick each process's
         # first local device, ordered by process index.
         per_proc = {}
@@ -86,6 +102,49 @@ class Backend:
         self._group_sharding = NamedSharding(self._group_mesh, P(WORLD_AXIS))
         self._rep_sharding = NamedSharding(self._group_mesh, P())
         self._initialized = True
+
+    def _fetch_elastic_slot(self):
+        """Long-poll the elastic rendezvous for this worker's SlotInfo.
+
+        Blocks (404-long-poll) while the driver is rebuilding the world, so a
+        resetting worker naturally waits for the new assignment. Raises
+        HorovodInternalError if this host was removed from the job
+        (reference gloo_context.cc:157-204 throws on removed host)."""
+        from ..runner.http_client import read_data_from_kvstore
+        from ..runner.hosts import SlotInfo
+        rdv_addr = os.environ[env_mod.HOROVOD_GLOO_RENDEZVOUS_ADDR]
+        rdv_port = int(os.environ[env_mod.HOROVOD_GLOO_RENDEZVOUS_PORT])
+        # A resume legitimately takes up to the driver's elastic timeout
+        # (waiting for replacement hosts), which is much longer than the
+        # plain gloo rendezvous timeout — don't kill surviving workers first.
+        timeout = float(os.environ.get(
+            "HOROVOD_ELASTIC_TIMEOUT",
+            os.environ.get(env_mod.HOROVOD_GLOO_TIMEOUT_SECONDS, "600")))
+        host = os.environ.get(env_mod.HOROVOD_HOSTNAME, "localhost")
+        local_rank = os.environ.get(env_mod.HOROVOD_LOCAL_RANK, "0")
+        # key carries the world version this process last belonged to so the
+        # rendezvous never re-serves the world we are leaving
+        last_version = int(os.environ.get("HOROVOD_TPU_WORLD_VERSION", "0"))
+        try:
+            data = read_data_from_kvstore(rdv_addr, rdv_port, "rank_and_size",
+                                          f"{host}:{local_rank}:{last_version}",
+                                          timeout=timeout)
+        except TimeoutError as e:
+            raise HorovodInternalError(
+                f"elastic rendezvous did not assign {host}:{local_rank} a "
+                f"rank within {timeout}s (job stopped?): {e}")
+        text = data.decode()
+        version = 0
+        if "|" in text:
+            version_s, text = text.split("|", 1)
+            version = int(version_s)
+        slot = SlotInfo.from_response_string(text)
+        if slot.rank < 0:
+            from ..common.exceptions import WorkerRemovedError
+            raise WorkerRemovedError(
+                f"slot {host}:{local_rank} was removed from the elastic job")
+        os.environ["HOROVOD_TPU_WORLD_VERSION"] = str(version)
+        return slot
 
     def _resolve_coordinator(self, proc_id: int):
         """Resolve the ``@rendezvous`` coordinator sentinel.
@@ -127,6 +186,27 @@ class Backend:
             except Exception:
                 pass
             self._distributed = False
+            # Tear down the XLA backends so a later init() can call
+            # jax.distributed.initialize() again with a NEW world — the
+            # TPU-native analog of the reference's full C++ core
+            # shutdown+re-init on elastic reset (torch/elastic.py:46,
+            # gloo_context.cc:157-204). Device arrays die with the backend;
+            # elastic state survives because State.save() keeps host copies.
+            try:
+                import jax._src.xla_bridge as xla_bridge
+                xla_bridge._clear_backends()
+                jax.clear_caches()
+            except Exception as e:
+                # A failed teardown makes elastic re-init silently reuse the
+                # old world's backend — fail loudly there instead of
+                # producing wrong-size meshes later.
+                if os.environ.get(env_mod.HOROVOD_ELASTIC):
+                    raise HorovodInternalError(
+                        f"could not tear down XLA backends for elastic "
+                        f"re-init (jax API change?): {e!r}")
+                import logging
+                logging.getLogger("horovod_tpu").warning(
+                    "XLA backend teardown failed: %r", e)
         self._initialized = False
         self._group_mesh = None
 

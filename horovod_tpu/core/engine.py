@@ -125,6 +125,11 @@ class Engine:
         # observability hooks, wired by GlobalState when timeline/stall are on
         self.on_enqueue: Optional[Callable[[str, str, int], None]] = None
         self.on_done: Optional[Callable[[str], None]] = None
+        # autotuner (parameter_manager.h): wired by GlobalState when
+        # HOROVOD_AUTOTUNE=1; scores throughput per drain-cycle and retunes
+        # fusion_threshold / cycle_time
+        self.parameter_manager = None
+        self._hier_ok: Optional[bool] = None
         # Cycle loop: the analog of RunLoopOnce (operations.cc:566-616) — wakes
         # every cycle_time_ms to retire completed handles so fire-and-forget
         # async ops clear the outstanding table without user poll/synchronize.
@@ -137,9 +142,10 @@ class Engine:
         self._running = False
 
     def _cycle_loop(self):
-        period = max(self.config.cycle_time_ms, 1.0) / 1000.0
         while self._running:
-            time.sleep(period)
+            # cycle time is re-read every iteration so the autotuner can
+            # retune it live (parameter_manager.h:178-220)
+            time.sleep(max(self.config.cycle_time_ms, 1.0) / 1000.0)
             with self._lock:
                 pending = list(self._outstanding.values())
             for h in pending:
@@ -196,6 +202,45 @@ class Engine:
         self._track(name, h)
         return h
 
+    def _hierarchical_ok(self) -> bool:
+        """One-time, *collectively agreed* decision whether hierarchical
+        allreduce is usable. Every rank must pick the same program
+        (mpi_controller.cc:26-82 homogeneity check): a rank-local local_size
+        test would diverge on heterogeneous host assignments, so the first
+        caller allgathers local_size and requires uniformity."""
+        if self._hier_ok is not None:
+            return self._hier_ok
+        local = self.backend.local_size()
+        size = self.backend.size()
+        if size == 1:
+            self._hier_ok = False
+            return False
+        sizes = self._exchange_sizes(np.array([local], dtype=np.int32))[:, 0]
+        self._hier_ok = bool((sizes == sizes[0]).all() and
+                             1 < local < size and size % local == 0)
+        return self._hier_ok
+
+    def _allreduce_builder(self, op: ReduceOp, prescale_factor: float,
+                           postscale_factor: float):
+        """Flat vs hierarchical allreduce dispatch (the role of
+        OperationManager priority selection, operations.cc:142-249):
+        hierarchical kicks in when HOROVOD_HIERARCHICAL_ALLREDUCE is set and
+        the (homogeneous) topology has a non-trivial (cross, local)
+        factorization."""
+        mesh = self.backend.group_mesh
+        local = self.backend.local_size()
+        if self.config.hierarchical_allreduce and self._hierarchical_ok():
+            return self._builder(
+                ("hier_allreduce", op, local, prescale_factor,
+                 postscale_factor),
+                lambda: C.build_hierarchical_allreduce(
+                    mesh, self._axis(), local, op, prescale_factor,
+                    postscale_factor))
+        return self._builder(
+            ("allreduce", op, prescale_factor, postscale_factor),
+            lambda: C.build_allreduce(mesh, self._axis(), op,
+                                      prescale_factor, postscale_factor))
+
     # -- collectives -------------------------------------------------------
 
     def allreduce(self, tensor, name: Optional[str] = None,
@@ -204,11 +249,7 @@ class Engine:
                   postscale_factor: float = 1.0) -> Handle:
         x = jnp.asarray(tensor)
         name = self._register(name, "allreduce", x.nbytes)
-        mesh = self.backend.group_mesh
-        fn = self._builder(("allreduce", op, prescale_factor, postscale_factor),
-                           lambda: C.build_allreduce(mesh, self._axis(), op,
-                                                     prescale_factor,
-                                                     postscale_factor))
+        fn = self._allreduce_builder(op, prescale_factor, postscale_factor)
         out = fn(self.backend.to_global(x))
         return self._single(name, out)
 
@@ -220,15 +261,19 @@ class Engine:
         <= fusion_threshold bucket per dtype), mirroring FuseResponses
         (controller.cc:652-773)."""
         tensors = [jnp.asarray(t) for t in tensors]
+        pm = self.parameter_manager
+        if pm is not None and pm.active:
+            # program-ordered autotune step boundary: score the previous
+            # step, possibly retune knobs (collective sync inside is safe
+            # here — every rank hits this call in the same order)
+            pm.step_mark(sum(t.nbytes for t in tensors))
+            self.config.fusion_threshold_bytes = pm.fusion_threshold_bytes
+            self.config.cycle_time_ms = pm.cycle_time_ms
         names = [self._register(None if name is None else f"{name}.{i}",
                                 "grouped_allreduce", t.nbytes)
                  for i, t in enumerate(tensors)]
         buckets = bucket_by_size(tensors, self.config.fusion_threshold_bytes)
-        mesh = self.backend.group_mesh
-        fn = self._builder(("allreduce", op, prescale_factor, postscale_factor),
-                           lambda: C.build_allreduce(mesh, self._axis(), op,
-                                                     prescale_factor,
-                                                     postscale_factor))
+        fn = self._allreduce_builder(op, prescale_factor, postscale_factor)
         results: Dict[int, jax.Array] = {}
         for idxs in buckets:
             packed, treedef = C.pack([tensors[i] for i in idxs])

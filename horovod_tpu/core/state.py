@@ -45,6 +45,22 @@ class GlobalState:
                 warning_seconds=cfg.stall_warning_seconds,
                 shutdown_seconds=cfg.stall_shutdown_seconds)
 
+        if cfg.autotune:
+            from ..autotune.parameter_manager import ParameterManager
+            from .. import functions
+            self.parameter_manager = ParameterManager(
+                warmup_samples=cfg.autotune_warmup_samples,
+                steps_per_sample=cfg.autotune_steps_per_sample,
+                max_samples=cfg.autotune_bayes_opt_max_samples,
+                gp_noise=cfg.autotune_gaussian_process_noise,
+                initial_threshold=cfg.fusion_threshold_bytes,
+                initial_cycle_ms=cfg.cycle_time_ms,
+                log_path=(cfg.autotune_log
+                          if self.backend.rank() == 0 else None),
+                bcast_object=(functions.broadcast_object
+                              if self.backend.size() > 1 else None))
+            self.engine.parameter_manager = self.parameter_manager
+
         engine = self.engine
         timeline = self.timeline
         stall = self.stall_inspector
@@ -74,6 +90,9 @@ class GlobalState:
             if self.stall_inspector is not None:
                 self.stall_inspector.stop()
                 self.stall_inspector = None
+            if self.parameter_manager is not None:
+                self.parameter_manager.close()
+                self.parameter_manager = None
             if self.backend is not None:
                 self.backend.shutdown()
             self.backend = None

@@ -1,0 +1,49 @@
+"""Elastic (fault-tolerant, auto-scaling) training.
+
+Parity map (reference → here):
+
+- ``horovod/common/elastic.py`` State/ObjectState/run_fn → :mod:`.state`,
+  :mod:`.run`
+- ``horovod/torch/elastic.py`` TorchState → :class:`.state.TPUState`
+- ``horovod/runner/elastic/discovery.py`` → :mod:`.discovery`
+- ``horovod/runner/elastic/registration.py`` → :mod:`.registration`
+- ``horovod/runner/elastic/driver.py`` → :mod:`.driver`
+- ``horovod/runner/elastic/rendezvous.py`` → :mod:`.rendezvous`
+- ``horovod/runner/elastic/worker.py`` → :mod:`.worker`
+
+Usage (same shape as the reference)::
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    state = hvd.elastic.TPUState(params=params, opt_state=opt_state, batch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.batch < n_batches:
+            state.params, state.opt_state = step(state.params, state.opt_state)
+            state.batch += 1
+            if state.batch % 10 == 0:
+                state.commit()
+
+    train(state)
+"""
+
+from .state import State, ObjectState, TPUState
+from .run import run, run_fn
+from .discovery import (HostDiscovery, HostDiscoveryScript, FixedHosts,
+                        HostManager, HostUpdateResult)
+from .registration import WorkerStateRegistry, READY, SUCCESS, FAILURE
+from .driver import ElasticDriver
+from .rendezvous import ElasticRendezvousServer
+from .worker import (WorkerNotificationManager, WorkerNotificationClient,
+                     WorkerNotificationService, notification_manager)
+
+__all__ = [
+    "State", "ObjectState", "TPUState", "run", "run_fn",
+    "HostDiscovery", "HostDiscoveryScript", "FixedHosts", "HostManager",
+    "HostUpdateResult", "WorkerStateRegistry", "ElasticDriver",
+    "ElasticRendezvousServer", "WorkerNotificationManager",
+    "WorkerNotificationClient", "WorkerNotificationService",
+    "notification_manager", "READY", "SUCCESS", "FAILURE",
+]

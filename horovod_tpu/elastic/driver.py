@@ -1,0 +1,331 @@
+"""Elastic driver: membership polling, rank assignment, worker lifecycle.
+
+Parity: reference ``horovod/runner/elastic/driver.py`` (ElasticDriver:
+discovery thread at driver.py:176-195, _activate_workers at :169-174,
+_handle_worker_exit → record failure → blacklist → resume at :291-307,
+worker notification at :197-225) rebuilt on the HTTP KV fabric.
+
+Worker lifecycle (same as reference): worker *processes* survive membership
+changes — on a reset they re-rendezvous in-process (``hvd.shutdown();
+hvd.init()``) and pick up a new rank. The driver starts processes only for
+newly-added slots and records exits.
+
+Resume protocol (replaces the reference's rendezvous versioning):
+
+1. A failure (worker exit ≠ 0) or relevant membership change marks a resume
+   *pending*. While pending, ``get_slot_info`` returns None, so re-rendezvous
+   GETs long-poll (404) instead of reading the dying world's plan.
+2. Live workers hit the rendezvous (READY); dead ones are recorded by their
+   process monitors (FAILURE). Once every worker of the old world is
+   accounted for, the registry barrier calls ``resume()``.
+3. ``resume()`` recomputes assignments from current membership, publishes the
+   new plan (clearing the stale JAX-coordinator address atomically with it),
+   and launches workers for newly-added slots.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..runner.hosts import SlotInfo, get_host_assignments
+from .discovery import HostDiscovery, HostManager, HostUpdateResult
+from .registration import WorkerStateRegistry
+
+_LOG = logging.getLogger("horovod_tpu.elastic")
+
+DISCOVER_HOSTS_FREQUENCY_SECS = 1.0
+ELASTIC_TIMEOUT_SECS = 600.0
+
+
+class ElasticDriver:
+    def __init__(self, rendezvous, discovery: HostDiscovery, min_np: int,
+                 max_np: Optional[int] = None,
+                 timeout: Optional[float] = None,
+                 reset_limit: Optional[int] = None, verbose: bool = False):
+        self._rendezvous = rendezvous
+        self._host_manager = HostManager(discovery)
+        self._min_np = min_np
+        self._max_np = max_np
+        self._timeout = timeout or ELASTIC_TIMEOUT_SECS
+        self._verbose = verbose
+
+        self._registry = WorkerStateRegistry(self, self._host_manager,
+                                             reset_limit=reset_limit,
+                                             verbose=verbose)
+        self._create_worker_fn: Optional[Callable] = None
+        self._assignments: List[SlotInfo] = []
+        self._started_slots: set = set()           # (host, local_rank)
+        self._world_version = 0
+        self._pending_resume = False
+        self._results: Dict[str, Tuple[object, int]] = {}
+
+        self._lock = threading.RLock()
+        self._shutdown = threading.Event()
+        self._finished_event = threading.Event()
+        self._error_message: Optional[str] = None
+        self._discovery_thread = threading.Thread(
+            target=self._discover_hosts, name="elastic-discovery", daemon=True)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, np: int, create_worker_fn: Callable[[SlotInfo], None]):
+        """Begin the job: wait for ``np`` slots, assign ranks, launch workers.
+
+        ``create_worker_fn(slot_info)`` must start (asynchronously) a worker
+        process for the slot and arrange for ``record_worker_exit`` to be
+        called when it terminates.
+        """
+        self._create_worker_fn = create_worker_fn
+        self._activate_workers(np)
+        self._discovery_thread.start()
+
+    def stop(self, error_message: Optional[str] = None):
+        with self._lock:
+            if error_message is not None and self._error_message is None:
+                self._error_message = error_message
+        self._shutdown.set()
+        self._finished_event.set()
+
+    def finished(self) -> bool:
+        return self._finished_event.is_set()
+
+    def wait_for_finished(self, timeout: Optional[float] = None) -> bool:
+        return self._finished_event.wait(timeout)
+
+    def join(self):
+        self._shutdown.set()
+        if self._discovery_thread.is_alive():
+            self._discovery_thread.join(timeout=5)
+
+    @property
+    def error_message(self) -> Optional[str]:
+        return self._error_message
+
+    def get_results(self) -> Dict[str, Tuple[object, int]]:
+        return dict(self._results)
+
+    @property
+    def host_manager(self) -> HostManager:
+        return self._host_manager
+
+    @property
+    def registry(self) -> WorkerStateRegistry:
+        return self._registry
+
+    @property
+    def world_version(self) -> int:
+        return self._world_version
+
+    def world_size(self) -> int:
+        with self._lock:
+            return len(self._assignments)
+
+    def resume_needed(self) -> bool:
+        with self._lock:
+            return self._pending_resume
+
+    def get_slot_info(self, host: str, local_rank: int) -> Optional[SlotInfo]:
+        """Current assignment for a worker, or None while a resume is
+        pending (the rendezvous turns None into a long-polled 404)."""
+        state, slot, _ = self.get_slot_state(host, local_rank)
+        return slot
+
+    def get_slot_state(self, host: str, local_rank: int,
+                       min_version: int = 0):
+        """(state, slot, world_version), state ∈ {'pending','assigned',
+        'removed'}.
+
+        'pending' → the world is being rebuilt, ask again (404/long-poll);
+        'assigned' → here is your SlotInfo;
+        'removed' → this slot is not part of the current world: the worker
+        should exit (reference gloo_context.cc:157-204 removed-host throw).
+
+        ``min_version`` is the world version the caller last belonged to: a
+        re-rendezvousing worker must NOT be handed the plan of the world it
+        just left (its peer may be dead but unreported yet — the reference
+        avoids this with rendezvous versioning), so anything ≤ min_version
+        is served as 'pending'.
+        """
+        with self._lock:
+            if self._pending_resume or self._world_version <= min_version:
+                return "pending", None, self._world_version
+            for s in self._assignments:
+                if s.hostname == host and s.local_rank == local_rank:
+                    return "assigned", s, self._world_version
+            return "removed", None, self._world_version
+
+    # -- membership / activation --------------------------------------------
+
+    def wait_for_available_slots(self, min_np: int) -> None:
+        """Block until discovery reports at least ``min_np`` usable slots
+        (reference driver.py:118-134)."""
+        deadline = time.monotonic() + self._timeout
+        while not self._shutdown.is_set():
+            self._host_manager.update_available_hosts()
+            avail = self._host_manager.available_slots()
+            if avail >= min_np:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"Timed out waiting for {min_np} slots "
+                    f"(have {avail}) after {self._timeout}s. Check that your "
+                    f"discovery script reports enough healthy hosts.")
+            time.sleep(DISCOVER_HOSTS_FREQUENCY_SECS)
+
+    def _activate_workers(self, min_np: int):
+        self.wait_for_available_slots(min_np)
+        with self._lock:
+            hosts = self._host_manager.current_hosts()
+            assignments = get_host_assignments(hosts, min_np, self._max_np)
+            self._world_version += 1
+            self._assignments = assignments
+            self._pending_resume = False
+            self._rendezvous.init(assignments)
+            self._registry.reset(
+                [f"{s.hostname}:{s.local_rank}" for s in assignments])
+            pending = [s for s in assignments
+                       if (s.hostname, s.local_rank) not in self._started_slots]
+            for s in pending:
+                self._started_slots.add((s.hostname, s.local_rank))
+                # a restarted slot's result belongs to a previous world —
+                # it must not satisfy this world's completion check
+                self._results.pop(f"{s.hostname}:{s.local_rank}", None)
+            _LOG.info("world v%d: %d workers (%d newly started)",
+                      self._world_version, len(assignments), len(pending))
+        for s in pending:
+            self._create_worker_fn(s)
+
+    def resume(self):
+        """Rebuild the world (reference driver.py:108-116). Runs in a fresh
+        thread because it is called from registry barriers."""
+        threading.Thread(target=self._resume_inner, daemon=True).start()
+
+    def _resume_inner(self):
+        try:
+            self._activate_workers(self._min_np)
+        except Exception as e:  # timeout waiting for slots, etc.
+            self.stop(error_message=str(e))
+
+    # -- discovery thread ---------------------------------------------------
+
+    def _discover_hosts(self):
+        while not self._shutdown.is_set():
+            try:
+                res = self._host_manager.update_available_hosts()
+            except Exception as e:
+                _LOG.warning("host discovery failed: %s", e)
+                res = HostUpdateResult.NO_UPDATE
+            if res != HostUpdateResult.NO_UPDATE and \
+                    self._membership_matters(res):
+                with self._lock:
+                    self._pending_resume = True
+                self._registry.invalidate_ready()
+                self._notify_workers_host_changes(res)
+            self._shutdown.wait(DISCOVER_HOSTS_FREQUENCY_SECS)
+
+    def _membership_matters(self, res: int) -> bool:
+        """Growth matters only below max_np; removal matters only if a host
+        of the current world went away."""
+        with self._lock:
+            assigned_hosts = {s.hostname for s in self._assignments}
+            current = {h.hostname for h in self._host_manager.current_hosts()}
+            if res & HostUpdateResult.REMOVED and (
+                    not assigned_hosts <= current or
+                    self._host_manager.available_slots() <
+                    len(self._assignments)):
+                return True
+            if res & HostUpdateResult.ADDED:
+                if self._max_np is not None and \
+                        len(self._assignments) >= self._max_np:
+                    return False
+                return self._host_manager.available_slots() > \
+                    len(self._assignments)
+        return False
+
+    def _notify_workers_host_changes(self, update_res: int):
+        """Push a hosts-updated event to every registered worker
+        (reference driver.py:197-225); workers raise HostsUpdatedInterrupt at
+        their next commit()."""
+        from .worker import WorkerNotificationClient
+        timestamp = int(time.time() * 1e6)
+        for rank, addr in self._worker_addresses().items():
+            try:
+                WorkerNotificationClient(addr).notify_hosts_updated(
+                    timestamp, update_res)
+            except Exception as e:
+                _LOG.debug("could not notify worker %s at %s: %s",
+                           rank, addr, e)
+
+    def _worker_addresses(self) -> Dict[str, str]:
+        store = getattr(self._rendezvous, "worker_addresses", None)
+        if callable(store):
+            return store()
+        return {}
+
+    # -- worker events (called by rendezvous handler / process monitors) ----
+
+    def record_ready(self, host: str, local_rank: int):
+        self._registry.record_ready(host, local_rank)
+
+    def record_worker_exit(self, host: str, local_rank: int, exit_code: int,
+                           result=None):
+        """Called by the launcher's process monitor on worker termination."""
+        key = f"{host}:{local_rank}"
+        self._results[key] = (result, exit_code)
+        if exit_code == 0:
+            with self._lock:
+                # the process is gone either way; a future resume that
+                # reassigns this slot must start a fresh one
+                self._started_slots.discard((host, local_rank))
+            self._registry.record_success(host, local_rank)
+            self._maybe_finish_on_success()
+        else:
+            with self._lock:
+                self._started_slots.discard((host, local_rank))
+                in_world = any(s.hostname == host and
+                               s.local_rank == local_rank
+                               for s in self._assignments)
+                if in_world:
+                    self._pending_resume = True
+            if in_world:
+                # READY states recorded when the (now dying) world was
+                # activated are stale: live workers must re-rendezvous
+                # before the barrier may fire (registry docstring).
+                self._registry.invalidate_ready()
+            if not in_world:
+                # a worker of a *previous* world died after being scaled
+                # out — not a failure of the current world
+                _LOG.info("stale worker %s exited %d; ignoring",
+                          key, exit_code)
+                return
+            # Liveness probe runs the user's discovery script — never under
+            # self._lock (it can take seconds and would wedge the rendezvous
+            # mid-recovery). A failing host that discovery no longer reports
+            # is permanently excluded (reference driver.py:136-139).
+            if not self._host_still_alive(host):
+                self._host_manager.blacklist(host)
+            self._registry.record_failure(host, local_rank)
+
+    def _host_still_alive(self, host: str) -> bool:
+        try:
+            found = \
+                self._host_manager._discovery.find_available_hosts_and_slots()
+        except Exception as e:
+            # A transiently failing discovery script must not blacklist a
+            # healthy host forever — assume alive, like the polling thread
+            # treats the same failure as NO_UPDATE.
+            _LOG.warning("discovery probe failed (%s); assuming host %s "
+                         "is still alive", e, host)
+            return True
+        return host in found
+
+    def _maybe_finish_on_success(self):
+        with self._lock:
+            expected = {f"{s.hostname}:{s.local_rank}"
+                        for s in self._assignments}
+            done = {k for k, (_, code) in self._results.items() if code == 0}
+            if expected and expected <= done:
+                self._finished_event.set()

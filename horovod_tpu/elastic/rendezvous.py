@@ -1,0 +1,90 @@
+"""Elastic rendezvous server: assignment lookups record worker readiness.
+
+Parity: reference ``horovod/runner/elastic/rendezvous.py`` —
+``ElasticRendezvousHandler``: GET ``rank_and_size/<host>:<slot>`` records the
+worker READY with the driver and returns its current SlotInfo
+(rendezvous.py:37-42); PUT ``worker_addresses/<rank>`` registers the worker's
+notification channel (rendezvous.py:44-55).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict
+
+from ..runner.http_server import RendezvousServer
+
+_LOG = logging.getLogger("horovod_tpu.elastic")
+
+
+class ElasticRendezvousServer(RendezvousServer):
+    """RendezvousServer wired to an ElasticDriver.
+
+    Differences from the static server:
+    - ``init(assignments)`` *versions* the plan: the coordinator address from
+      the previous world is cleared so re-rendezvousing workers long-poll for
+      the new rank-0's address instead of reading a stale one.
+    - rank_and_size GETs notify the driver (readiness barrier accounting).
+    """
+
+    SCOPE_WORKER_ADDRS = "worker_addresses"
+
+    def __init__(self, addr=("0.0.0.0", 0)):
+        super().__init__(addr)
+        self._driver = None
+
+    def set_driver(self, driver):
+        self._driver = driver
+
+    def init(self, host_assignments, coordinator_addr=None):
+        with self._lock:
+            self._slots_by_key = {
+                f"{s.hostname}:{s.local_rank}": s for s in host_assignments}
+            # New world ⇒ new JAX coordinator; drop the stale address so
+            # non-zero ranks block until the new rank 0 republishes it
+            # (ordering guaranteed by this lock: any GET that sees the new
+            # plan also sees the cleared coordinator scope).
+            self._store.pop(self.SCOPE_COORD, None)
+            # stale notification endpoints would each cost a 5s connect
+            # timeout on every membership push; workers reregister after
+            # reset anyway
+            self._store.pop(self.SCOPE_WORKER_ADDRS, None)
+            if coordinator_addr is not None:
+                self._store[self.SCOPE_COORD]["addr"] = \
+                    coordinator_addr.encode()
+        return self.port
+
+    def handle_get(self, scope: str, key: str, handler):
+        if scope == self.SCOPE_RANK and self._driver is not None:
+            # key = "<host>:<local_rank>[:<last_world_version>]" — the
+            # version lets a resetting worker refuse the plan of the world
+            # it just left (driver.get_slot_state docstring).
+            min_version = 0
+            parts = key.split(":")
+            try:
+                if len(parts) >= 3:
+                    min_version = int(parts[-1])
+                    parts = parts[:-1]
+                local_rank = int(parts[-1])
+                host = ":".join(parts[:-1])
+            except (ValueError, IndexError):
+                return None
+            self._driver.record_ready(host, local_rank)
+            state, slot, version = self._driver.get_slot_state(
+                host, local_rank, min_version)
+            if state == "pending":
+                return None                 # 404 → client long-polls
+            if state == "removed":
+                # serve INVALID_SLOT_INFO: the worker exits cleanly
+                from ..runner.hosts import INVALID_SLOT_INFO
+                return (f"{version}|" +
+                        INVALID_SLOT_INFO.to_response_string()).encode()
+            return (f"{version}|" + slot.to_response_string()).encode()
+        return super().handle_get(scope, key, handler)
+
+    def worker_addresses(self) -> Dict[str, str]:
+        """rank → ``host:port`` of each worker's notification service."""
+        with self._lock:
+            return {k: v.decode()
+                    for k, v in self._store.get(self.SCOPE_WORKER_ADDRS,
+                                                {}).items()}

@@ -1,0 +1,76 @@
+"""Elastic run-loop wrapper.
+
+Parity: reference ``horovod/common/elastic.py:147-168`` (``run_fn``) +
+``torch/elastic.py:31-49`` (``run``/``reset``): wrap the user's training
+function so that
+
+- ``HorovodInternalError`` (a failed collective — a peer died) restores the
+  last committed state, resets the runtime, and retries;
+- ``HostsUpdatedInterrupt`` (driver saw membership change) resets and
+  continues without restore.
+
+The TPU-native ``reset`` tears down and re-initializes the whole runtime
+(``hvd.shutdown(); hvd.init()``) — a full re-rendezvous, new world size, new
+mesh, and (by construction) new jitted executables, exactly as the reference
+re-inits its C++ core (torch/elastic.py:46, gloo_context.cc:157-204).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+
+from ..common.exceptions import (HorovodInternalError, HostsUpdatedInterrupt,
+                                 WorkerRemovedError)
+from .worker import notification_manager
+
+_LOG = logging.getLogger("horovod_tpu.elastic")
+
+
+def _reset():
+    import horovod_tpu as hvd
+    hvd.shutdown()
+    hvd.init()
+
+
+def run(func):
+    """Decorator for elastic training functions: ``@hvd.elastic.run`` over
+    ``def train(state, ...)``. The first argument must be the elastic
+    ``State``."""
+    return run_fn(func, _reset)
+
+
+def run_fn(func, reset):
+    @functools.wraps(func)
+    def wrapper(state, *args, **kwargs):
+        notification_manager().init()
+        notification_manager().register_listener(state)
+        skip_sync = False
+        try:
+            while True:
+                if not skip_sync:
+                    state.sync()
+                try:
+                    return func(state, *args, **kwargs)
+                except HorovodInternalError:
+                    _LOG.info("collective failure; restoring last committed "
+                              "state and re-initializing")
+                    state.restore()
+                    skip_sync = False
+                except HostsUpdatedInterrupt as e:
+                    _LOG.info("hosts updated (skip_sync=%s); "
+                              "re-initializing", e.skip_sync)
+                    skip_sync = e.skip_sync
+                try:
+                    reset()
+                except WorkerRemovedError:
+                    # this worker was scaled out of the job: a clean exit
+                    _LOG.info("worker removed from job; exiting")
+                    return None
+                # ranks shift with the new world: re-advertise the
+                # notification address under the new rank
+                notification_manager().reregister()
+                state.on_reset()
+        finally:
+            notification_manager().remove_listener(state)
+    return wrapper

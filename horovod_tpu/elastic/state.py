@@ -1,0 +1,206 @@
+"""Elastic state: the in-memory checkpoint contract.
+
+Parity: reference ``horovod/common/elastic.py`` — ``State`` (commit / save /
+restore / sync / reset-callbacks / check_host_updates, elastic.py:26-144) and
+``ObjectState``; plus the JAX-native ``TPUState`` which plays the role of the
+framework states (``torch/elastic.py:51`` TorchState,
+``tensorflow/elastic.py:91`` TensorFlowState): pytrees of params / optimizer
+state / plain attributes, committed to host RAM and broadcast from the
+longest-surviving rank 0 after a reset.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+from typing import Any, Callable, Dict, List, Optional
+
+from ..common.exceptions import HostsUpdatedInterrupt
+from .discovery import HostUpdateResult
+
+_LOG = logging.getLogger("horovod_tpu.elastic")
+
+
+class State:
+    """Base elastic state (reference common/elastic.py:26-101).
+
+    - ``commit()``: save a restore point, then check for host updates.
+    - ``check_host_updates()``: raise HostsUpdatedInterrupt if the driver
+      notified us of membership changes (cheap; call every batch).
+    - ``save()/restore()``: host-RAM checkpoint of the tracked values.
+    - ``sync()``: broadcast state from rank 0 to all workers.
+    """
+
+    def __init__(self, bcast_object: Optional[Callable] = None,
+                 get_rank: Optional[Callable] = None):
+        import horovod_tpu as hvd
+        from .. import functions
+        self._bcast_object = bcast_object or functions.broadcast_object
+        self._rank = get_rank or hvd.rank
+        self._host_messages: "queue.Queue" = queue.Queue()
+        self._last_updated_timestamp = 0
+        self._reset_callbacks: List[Callable] = []
+
+    # -- user hooks ---------------------------------------------------------
+
+    def register_reset_callbacks(self, callbacks: List[Callable]):
+        """Callbacks invoked after a reset (world resize), e.g. to rescale the
+        learning rate to the new world size (reference elastic.py:44-52)."""
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self):
+        self._host_messages = queue.Queue()
+        self.reset()
+        for callback in self._reset_callbacks:
+            callback()
+
+    def on_hosts_updated(self, timestamp: int, update_res: int):
+        """Notification-manager listener entry point."""
+        self._host_messages.put((timestamp, update_res))
+
+    # -- commit protocol ----------------------------------------------------
+
+    def commit(self):
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self):
+        """Drain pending host-update messages; decide *on rank 0* whether
+        membership changed, and broadcast that decision so every worker
+        interrupts at the same batch (reference elastic.py:73-93 — the
+        (prev, last, res) triple is synced from rank 0 before raising)."""
+        prev_timestamp = self._last_updated_timestamp
+        last = prev_timestamp
+        all_res = HostUpdateResult.NO_UPDATE
+        while not self._host_messages.empty():
+            timestamp, res = self._host_messages.get()
+            if timestamp > last:
+                last = timestamp
+            all_res |= res
+        prev_timestamp, last, all_res = self._bcast_object(
+            (prev_timestamp, last, all_res), name="elastic.host_updates")
+        self._last_updated_timestamp = last
+        if last > prev_timestamp:
+            # Additions-only updates keep existing state valid: skip the
+            # next sync (reference HostsUpdatedInterrupt(res == added)).
+            raise HostsUpdatedInterrupt(
+                skip_sync=(all_res == HostUpdateResult.ADDED))
+
+    # -- to be implemented by subclasses ------------------------------------
+
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class ObjectState(State):
+    """State of arbitrary picklable attributes (reference
+    common/elastic.py:104-144). Attributes are set via kwargs and tracked;
+    ``sync`` broadcasts the attribute dict from rank 0."""
+
+    def __init__(self, bcast_object: Optional[Callable] = None,
+                 get_rank: Optional[Callable] = None, **kwargs):
+        self._saved_state: Dict[str, Any] = kwargs
+        super().__init__(bcast_object=bcast_object, get_rank=get_rank)
+        self._set_attrs()
+
+    def save(self):
+        new_state = {}
+        for attr in self._saved_state.keys():
+            new_state[attr] = getattr(self, attr)
+        self._saved_state = new_state
+
+    def restore(self):
+        self._set_attrs()
+
+    def sync(self):
+        if self._saved_state:
+            self._saved_state = self._bcast_object(
+                self._saved_state, name="elastic.object_state")
+            self._set_attrs()
+
+    def _set_attrs(self):
+        for attr, value in self._saved_state.items():
+            setattr(self, attr, value)
+
+
+class TPUState(ObjectState):
+    """JAX-native elastic state: tracks ``params`` / ``opt_state`` pytrees
+    (device arrays) plus plain object attributes.
+
+    Role parity: TorchState (torch/elastic.py:51) — model/optimizer tensors
+    are committed to host RAM (``jax.device_get``) and restored/broadcast as
+    pytrees. Device placement after restore follows the current mesh, so a
+    restore after a world resize re-shards automatically.
+    """
+
+    PYTREE_ATTRS = ("params", "opt_state")
+
+    def __init__(self, params=None, opt_state=None,
+                 bcast_object: Optional[Callable] = None,
+                 get_rank: Optional[Callable] = None, **kwargs):
+        self._pytrees: Dict[str, Any] = {}
+        self._saved_pytrees: Dict[str, Any] = {}
+        if params is not None:
+            self._pytrees["params"] = params
+        if opt_state is not None:
+            self._pytrees["opt_state"] = opt_state
+        super().__init__(bcast_object=bcast_object, get_rank=get_rank,
+                         **kwargs)
+        self._save_pytrees()
+
+    # pytree attrs are exposed as normal attributes
+    def __getattr__(self, name):
+        trees = self.__dict__.get("_pytrees", {})
+        if name in trees:
+            return trees[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name in self.PYTREE_ATTRS:
+            self._pytrees[name] = value
+        else:
+            super().__setattr__(name, value)
+
+    def _save_pytrees(self):
+        import jax
+        self._saved_pytrees = {k: jax.device_get(v)
+                               for k, v in self._pytrees.items()}
+
+    def save(self):
+        self._save_pytrees()
+        super().save()
+
+    def restore(self):
+        # Host-side only (numpy leaves): restore may run *before* the elastic
+        # reset tears down the XLA backend (run.py order: restore → reset),
+        # so materializing on-device here would pin arrays of the dying
+        # client. Device placement happens lazily at next use, on whatever
+        # backend is then live.
+        import numpy as np
+        import jax
+        for k, host_tree in self._saved_pytrees.items():
+            self._pytrees[k] = jax.tree_util.tree_map(np.asarray, host_tree)
+        super().restore()
+
+    def reset(self):
+        # After a runtime reset the previous backend (and every device array
+        # of it) is gone — rehydrate pytrees from the last committed host
+        # copies so sync()/training touch only live data.
+        self.restore()
+
+    def sync(self):
+        from .. import functions
+        for k in list(self._pytrees.keys()):
+            self._pytrees[k] = functions.broadcast_parameters(
+                self._pytrees[k], root_rank=0)
+        self._save_pytrees()
+        super().sync()

@@ -1,0 +1,162 @@
+"""Worker-side notification channel for host-membership updates.
+
+Parity: reference ``horovod/runner/elastic/worker.py`` —
+``WorkerNotificationManager/Service/Client``: the driver pushes a
+"hosts updated" event into a tiny in-worker HTTP service; registered
+listeners (elastic ``State`` objects) pick it up and raise
+``HostsUpdatedInterrupt`` at the next ``commit()`` boundary.
+
+Transport here is the same HTTP KV fabric as the rendezvous (PUT
+``/notify/hosts_updated`` with ``"<timestamp> <update_result>"``), replacing
+the reference's HMAC-pickled socket RPC.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+from typing import List, Optional
+
+from ..common import env as env_mod
+from ..runner.http_server import KVStoreServer
+from ..runner.http_client import put_data_into_kvstore
+
+_LOG = logging.getLogger("horovod_tpu.elastic")
+
+SCOPE_NOTIFY = "notify"
+KEY_HOSTS_UPDATED = "hosts_updated"
+SCOPE_WORKER_ADDRS = "worker_addresses"
+
+
+class WorkerNotificationService(KVStoreServer):
+    """In-worker HTTP endpoint the driver pushes membership events to."""
+
+    def __init__(self, manager: "WorkerNotificationManager"):
+        super().__init__(("0.0.0.0", 0))
+        self._manager = manager
+
+    def handle_put(self, scope: str, key: str, value: bytes, handler) -> int:
+        if scope == SCOPE_NOTIFY and key == KEY_HOSTS_UPDATED:
+            try:
+                ts_s, res_s = value.decode().split()
+                self._manager.handle_hosts_updated(int(ts_s), int(res_s))
+                return 200
+            except (ValueError, UnicodeDecodeError):
+                return 400
+        return super().handle_put(scope, key, value, handler)
+
+
+class WorkerNotificationManager:
+    """Singleton-ish per-process manager: starts the service on demand,
+    registers the worker's address with the rendezvous, and fans events out
+    to registered listeners (reference worker.py:24-83)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._service: Optional[WorkerNotificationService] = None
+        self._listeners: List[object] = []
+        self._rdv: Optional[tuple] = None       # (addr, port)
+        self._my_addr: Optional[str] = None
+
+    def init(self, rendezvous_addr: Optional[str] = None,
+             rendezvous_port: Optional[int] = None,
+             rank: Optional[int] = None, hostname: Optional[str] = None):
+        """Start the service and advertise ``host:port`` under
+        ``worker_addresses/<rank>`` in the rendezvous KV. No-ops when not
+        running under an elastic driver (no rendezvous in env)."""
+        with self._lock:
+            if self._service is not None:
+                return
+            addr = rendezvous_addr or os.environ.get(
+                env_mod.HOROVOD_GLOO_RENDEZVOUS_ADDR)
+            if not addr:
+                return
+            port = rendezvous_port if rendezvous_port is not None else \
+                int(os.environ.get(env_mod.HOROVOD_GLOO_RENDEZVOUS_PORT, "0"))
+            if rank is None:
+                rank = int(os.environ.get(env_mod.HOROVOD_RANK, "0"))
+            self._service = WorkerNotificationService(self)
+            self._service.start()
+            host = hostname or os.environ.get(env_mod.HOROVOD_HOSTNAME) or \
+                socket.gethostname()
+            self._rdv = (addr, port)
+            self._my_addr = f"{host}:{self._service.port}"
+            put_data_into_kvstore(addr, port, SCOPE_WORKER_ADDRS, str(rank),
+                                  self._my_addr.encode())
+            _LOG.debug("worker notification service at %s (rank %s)",
+                       self._my_addr, rank)
+
+    def reregister(self, rank: Optional[int] = None):
+        """Re-advertise this worker's address after a reset: the global rank
+        may have changed with the new world, and the old rank's key may have
+        been claimed by another worker."""
+        with self._lock:
+            if self._service is None or self._rdv is None:
+                return
+            if rank is None:
+                rank = int(os.environ.get(env_mod.HOROVOD_RANK, "0"))
+            addr, port = self._rdv
+            try:
+                put_data_into_kvstore(addr, port, SCOPE_WORKER_ADDRS,
+                                      str(rank), self._my_addr.encode(),
+                                      timeout=10)
+            except Exception as e:
+                _LOG.debug("notification re-registration failed: %s", e)
+
+    def shutdown(self):
+        with self._lock:
+            if self._service is not None:
+                self._service.stop()
+                self._service = None
+
+    @property
+    def port(self) -> Optional[int]:
+        with self._lock:
+            return self._service.port if self._service else None
+
+    # -- listeners ----------------------------------------------------------
+
+    def register_listener(self, listener):
+        with self._lock:
+            if listener not in self._listeners:
+                self._listeners.append(listener)
+
+    def remove_listener(self, listener):
+        with self._lock:
+            if listener in self._listeners:
+                self._listeners.remove(listener)
+
+    def handle_hosts_updated(self, timestamp: int, update_res: int):
+        with self._lock:
+            listeners = list(self._listeners)
+        for l in listeners:
+            l.on_hosts_updated(timestamp, update_res)
+
+
+class WorkerNotificationClient:
+    """Driver-side push client (reference worker.py:86-110)."""
+
+    def __init__(self, addr: str):
+        host, _, port = addr.rpartition(":")
+        self._host = host
+        self._port = int(port)
+
+    def notify_hosts_updated(self, timestamp: int, update_res: int):
+        put_data_into_kvstore(self._host, self._port, SCOPE_NOTIFY,
+                              KEY_HOSTS_UPDATED,
+                              f"{timestamp} {update_res}".encode(),
+                              timeout=5)
+
+
+_manager: Optional[WorkerNotificationManager] = None
+_manager_lock = threading.Lock()
+
+
+def notification_manager() -> WorkerNotificationManager:
+    global _manager
+    with _manager_lock:
+        if _manager is None:
+            _manager = WorkerNotificationManager()
+        return _manager

@@ -1,0 +1,174 @@
+// Chrome-trace timeline writer (native core).
+//
+// Parity: reference horovod/common/timeline.{h,cc} — catapult-format JSON
+// (timeline.h:79-81), a dedicated writer thread fed by a producer queue so
+// the hot enqueue path never touches the filesystem (timeline.h:66-75), and
+// per-tensor NEGOTIATING→TOP_LEVEL→ACTIVITY phase events.
+//
+// C API consumed from Python via ctypes (no pybind11 in this image).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+
+namespace {
+
+struct Event {
+  char ph;            // 'B' begin, 'E' end, 'X' complete, 'i' instant, 'M' meta
+  int64_t ts_us;
+  int64_t dur_us;     // for 'X'
+  int64_t tid;
+  std::string name;
+  std::string args_json;  // optional pre-rendered {"k":v} payload
+};
+
+class TimelineWriter {
+ public:
+  bool Open(const char* path) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (file_) return false;
+    file_ = std::fopen(path, "w");
+    if (!file_) return false;
+    std::fputs("[\n", file_);
+    first_ = true;
+    stop_.store(false);
+    writer_ = std::thread(&TimelineWriter::Loop, this);
+    return true;
+  }
+
+  void Push(Event&& e) {
+    {
+      std::lock_guard<std::mutex> g(qmu_);
+      queue_.emplace_back(std::move(e));
+    }
+    cv_.notify_one();
+  }
+
+  void Close() {
+    {
+      std::lock_guard<std::mutex> g(qmu_);
+      stop_.store(true);
+    }
+    cv_.notify_one();
+    if (writer_.joinable()) writer_.join();
+    std::lock_guard<std::mutex> g(mu_);
+    if (file_) {
+      std::fputs("\n]\n", file_);
+      std::fclose(file_);
+      file_ = nullptr;
+    }
+  }
+
+ private:
+  static void JsonEscape(const std::string& in, std::string* out) {
+    for (char c : in) {
+      switch (c) {
+        case '"': *out += "\\\""; break;
+        case '\\': *out += "\\\\"; break;
+        case '\n': *out += "\\n"; break;
+        case '\t': *out += "\\t"; break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char buf[8];
+            std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+            *out += buf;
+          } else {
+            *out += c;
+          }
+      }
+    }
+  }
+
+  void WriteOne(const Event& e) {
+    std::string name;
+    JsonEscape(e.name, &name);
+    std::string line;
+    if (!first_) line += ",\n";
+    first_ = false;
+    char head[160];
+    std::snprintf(head, sizeof(head),
+                  "{\"ph\":\"%c\",\"pid\":0,\"tid\":%lld,\"ts\":%lld",
+                  e.ph, static_cast<long long>(e.tid),
+                  static_cast<long long>(e.ts_us));
+    line += head;
+    if (e.ph == 'X') {
+      char dur[48];
+      std::snprintf(dur, sizeof(dur), ",\"dur\":%lld",
+                    static_cast<long long>(e.dur_us));
+      line += dur;
+    }
+    line += ",\"name\":\"" + name + "\"";
+    if (e.ph == 'M') {
+      // metadata events name threads: args = {"name": <name>}
+      line += ",\"args\":{\"name\":\"" + name + "\"}";
+    } else if (!e.args_json.empty()) {
+      line += ",\"args\":" + e.args_json;
+    }
+    line += "}";
+    std::fputs(line.c_str(), file_);
+  }
+
+  void Loop() {
+    std::deque<Event> batch;
+    for (;;) {
+      {
+        std::unique_lock<std::mutex> l(qmu_);
+        cv_.wait(l, [&] { return stop_.load() || !queue_.empty(); });
+        batch.swap(queue_);
+      }
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        if (!file_) return;
+        for (const auto& e : batch) WriteOne(e);
+        std::fflush(file_);
+      }
+      batch.clear();
+      if (stop_.load()) {
+        std::lock_guard<std::mutex> l(qmu_);
+        if (queue_.empty()) return;
+      }
+    }
+  }
+
+  std::mutex mu_;       // file
+  std::mutex qmu_;      // queue
+  std::condition_variable cv_;
+  std::deque<Event> queue_;
+  std::FILE* file_ = nullptr;
+  bool first_ = true;
+  std::atomic<bool> stop_{false};
+  std::thread writer_;
+};
+
+TimelineWriter g_writer;
+
+}  // namespace
+
+extern "C" {
+
+int hvd_timeline_open(const char* path) {
+  return g_writer.Open(path) ? 0 : -1;
+}
+
+// ph: 'B','E','X','i','M'; ts/dur in microseconds.
+void hvd_timeline_event(char ph, const char* name, int64_t ts_us,
+                        int64_t dur_us, int64_t tid, const char* args_json) {
+  Event e;
+  e.ph = ph;
+  e.ts_us = ts_us;
+  e.dur_us = dur_us;
+  e.tid = tid;
+  e.name = name ? name : "";
+  e.args_json = args_json ? args_json : "";
+  g_writer.Push(std::move(e));
+}
+
+void hvd_timeline_close() { g_writer.Close(); }
+
+}  // extern "C"

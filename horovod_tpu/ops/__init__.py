@@ -1,0 +1,12 @@
+"""Data-plane collective ops (reference horovod/common/ops/ rebuilt as XLA
+collectives — see :mod:`.collectives`), Adasum (:mod:`.adasum`), and gradient
+compression (:mod:`.compression`)."""
+
+from .collectives import (allreduce_p, allgather_p, broadcast_p, alltoall_p,
+                          reducescatter_p, hierarchical_allreduce_p)
+from .adasum import adasum_p
+from .compression import Compression
+
+__all__ = ["allreduce_p", "allgather_p", "broadcast_p", "alltoall_p",
+           "reducescatter_p", "hierarchical_allreduce_p", "adasum_p",
+           "Compression"]

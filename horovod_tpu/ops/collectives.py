@@ -74,6 +74,50 @@ def allreduce_p(x, axis_name: str, op: ReduceOp = ReduceOp.SUM,
     return out
 
 
+def hierarchical_allreduce_p(x, local_axis: str, cross_axis: str,
+                             op: ReduceOp = ReduceOp.SUM,
+                             prescale_factor: float = 1.0,
+                             postscale_factor: float = 1.0):
+    """Two-level allreduce over a (cross, local) mesh.
+
+    TPU-native rebuild of NCCLHierarchicalAllreduce
+    (ops/nccl_operations.cc:180-383): reduce-scatter within the fast
+    ``local`` (ICI) axis, allreduce the shards across the slow ``cross``
+    (DCN) axis, then all-gather back along ``local`` — cross-axis traffic is
+    1/local_size of the naive allreduce, the same bandwidth win as the
+    reference's NCCL-ReduceScatter → MPI-Allreduce → NCCL-Allgather ladder.
+
+    Falls back to padding when the leading dim does not divide local_size
+    (the local_size-divisible split math at nccl_operations.cc:227-277).
+    """
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        # min/max/product have no reduce-scatter decomposition benefit;
+        # do the flat two-phase reduce
+        out = allreduce_p(x, local_axis, op, prescale_factor, 1.0)
+        return allreduce_p(out, cross_axis, op, 1.0, postscale_factor)
+    if prescale_factor != 1.0:
+        x = x * prescale_factor
+    local_size = lax.psum(1, local_axis)
+    orig_shape = x.shape
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    pad = (-n) % local_size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    shard = lax.psum_scatter(flat, local_axis, scatter_dimension=0,
+                             tiled=True)
+    shard = lax.psum(shard, cross_axis)
+    out = lax.all_gather(shard, local_axis, axis=0, tiled=True)
+    if pad:
+        out = out[:n]
+    out = out.reshape(orig_shape)
+    if op == ReduceOp.AVERAGE:
+        out = out / (local_size * lax.psum(1, cross_axis))
+    if postscale_factor != 1.0:
+        out = out * postscale_factor
+    return out
+
+
 def allgather_p(x, axis_name: str):
     """Concatenate equal-shape per-rank tensors along dim 0 (reference
     allgather semantics, collective_operations.cc:88-195 fast path)."""
@@ -132,6 +176,64 @@ def build_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
     def body(x):  # x block: (1, *s)
         v = allreduce_p(x[0], axis, op, prescale_factor, postscale_factor)
         return v[None]
+
+    fn = _shmap(body, mesh, axis, in_specs=P(axis), out_specs=P(axis))
+    return jax.jit(fn)
+
+
+def build_hierarchical_allreduce(mesh: Mesh, axis: str, local_size: int,
+                                 op: ReduceOp,
+                                 prescale_factor: float = 1.0,
+                                 postscale_factor: float = 1.0):
+    """Stacked hierarchical allreduce (HOROVOD_HIERARCHICAL_ALLREDUCE,
+    reference NCCLHierarchicalAllreduce nccl_operations.cc:180-383 and its
+    dispatch at operations.cc:158-202).
+
+    Runs on the same 1-D group mesh as the flat builder; the (cross, local)
+    decomposition is expressed with ``axis_index_groups``: reduce-scatter
+    within each local (ICI) group, psum across groups (DCN), all-gather back
+    — cross traffic shrinks by 1/local_size.
+    """
+    n = int(mesh.devices.size)
+    assert n % local_size == 0, (n, local_size)
+    cross = n // local_size
+    local_groups = [[c * local_size + l for l in range(local_size)]
+                    for c in range(cross)]
+    cross_groups = [[c * local_size + l for c in range(cross)]
+                    for l in range(local_size)]
+
+    def body(x):  # x block: (1, *s)
+        v = x[0]
+        if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            out = allreduce_p(v, axis, op, prescale_factor, postscale_factor)
+            return out[None]
+        if prescale_factor != 1.0:
+            v = v * prescale_factor
+        orig_shape = v.shape
+        flat = v.reshape(-1)
+        pad = (-flat.shape[0]) % n
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+        # full reduce-scatter → reduce-scatter → all-gather → all-gather
+        # ladder: local RS (ICI), cross RS+AG (DCN at 1/local_size volume),
+        # local AG (ICI) — the reference's RS→AR→AG with the cross AR itself
+        # split into RS+AG
+        shard = lax.psum_scatter(flat, axis, scatter_dimension=0, tiled=True,
+                                 axis_index_groups=local_groups)
+        shard = lax.psum_scatter(shard, axis, scatter_dimension=0, tiled=True,
+                                 axis_index_groups=cross_groups)
+        out = lax.all_gather(shard, axis, axis=0, tiled=True,
+                             axis_index_groups=cross_groups)
+        out = lax.all_gather(out, axis, axis=0, tiled=True,
+                             axis_index_groups=local_groups)
+        if pad:
+            out = out[:flat.shape[0] - pad]
+        out = out.reshape(orig_shape)
+        if op == ReduceOp.AVERAGE:
+            out = out / n
+        if postscale_factor != 1.0:
+            out = out * postscale_factor
+        return out[None]
 
     fn = _shmap(body, mesh, axis, in_specs=P(axis), out_specs=P(axis))
     return jax.jit(fn)

@@ -1,0 +1,121 @@
+"""Autotuner tests: GP regression, EI acquisition, ParameterManager loop.
+
+Mirrors the role of the reference's autotuning stack
+(common/parameter_manager.{h,cc}, common/optim/) — validated here against
+synthetic objectives rather than live comm throughput.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from horovod_tpu.autotune import (BayesianOptimizer, GaussianProcessRegressor,
+                                  ParameterManager, expected_improvement)
+
+MB = 1024 * 1024
+
+
+class TestGaussianProcess:
+    def test_interpolates_training_points(self):
+        x = np.linspace(0, 1, 6)[:, None]
+        y = np.sin(2 * np.pi * x[:, 0])
+        gp = GaussianProcessRegressor(alpha=1e-10).fit(x, y)
+        mean, std = gp.predict(x)
+        np.testing.assert_allclose(mean, y, atol=1e-3)
+        assert np.all(std < 0.1)
+
+    def test_uncertainty_grows_away_from_data(self):
+        x = np.array([[0.0], [0.1]])
+        y = np.array([0.0, 0.1])
+        gp = GaussianProcessRegressor(length_scale=0.1, alpha=1e-8).fit(
+            x, y, optimize_hyperparams=False)
+        _, std_near = gp.predict(np.array([[0.05]]))
+        _, std_far = gp.predict(np.array([[2.0]]))
+        assert std_far[0] > std_near[0]
+
+
+class TestExpectedImprovement:
+    def test_prefers_high_mean(self):
+        mean = np.array([0.0, 1.0])
+        std = np.array([0.1, 0.1])
+        ei = expected_improvement(mean, std, best_y=0.5)
+        assert ei[1] > ei[0]
+
+    def test_prefers_high_uncertainty_at_equal_mean(self):
+        mean = np.array([0.5, 0.5])
+        std = np.array([0.01, 0.5])
+        ei = expected_improvement(mean, std, best_y=0.5)
+        assert ei[1] > ei[0]
+
+
+class TestBayesianOptimizer:
+    def test_finds_peak_of_smooth_objective(self):
+        # maximize -(x-0.7)² over [0,1]
+        opt = BayesianOptimizer([(0.0, 1.0)], seed=1)
+        for _ in range(25):
+            x = opt.suggest()
+            y = -float((x[0] - 0.7) ** 2)
+            opt.register(x, y)
+        best_x, best_y = opt.best()
+        assert abs(best_x[0] - 0.7) < 0.12, best_x
+
+
+class TestParameterManager:
+    def _drive(self, pm, score_fn, n_samples=40):
+        """Feed synthetic throughput: score depends on current knobs."""
+        pm.step_mark(8 * MB)
+        for _ in range(n_samples):
+            if not pm.active:
+                break
+            for _ in range(pm._steps_per_sample):
+                # synthesize elapsed time so that throughput follows score_fn
+                pm._step_start -= 1.0 / score_fn(pm.fusion_threshold_bytes)
+                pm.step_mark(8 * MB)
+
+    def test_converges_to_better_threshold(self, tmp_path):
+        log = str(tmp_path / "autotune.csv")
+        pm = ParameterManager(warmup_samples=1, steps_per_sample=3,
+                              max_samples=12, gp_noise=1e-3,
+                              initial_threshold=2 * MB, log_path=log)
+
+        # throughput peaks at 64MB threshold (log2 = 26)
+        def score(threshold):
+            return 1000.0 / (1.0 + (np.log2(threshold) - 26.0) ** 2)
+
+        self._drive(pm, score)
+        assert not pm.active  # converged & frozen
+        # should end well above the (bad) 2MB start and near the peak
+        assert 16 * MB <= pm.fusion_threshold_bytes <= 256 * MB
+        with open(log) as f:
+            lines = f.read().strip().splitlines()
+        assert lines[0].startswith("sample,")
+        assert lines[-1].startswith("best,")
+
+    def test_engine_integration(self):
+        """HOROVOD_AUTOTUNE=1 retunes engine config live."""
+        import horovod_tpu as hvd
+        from horovod_tpu.core.state import global_state
+        os.environ["HOROVOD_AUTOTUNE"] = "1"
+        os.environ["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] = "0"
+        os.environ["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"] = "1"
+        os.environ["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] = "4"
+        try:
+            hvd.shutdown()
+            hvd.init()
+            st = global_state()
+            assert st.parameter_manager is not None
+            grads = [np.ones((64, 64), np.float32) for _ in range(4)]
+            for i in range(8):
+                hs = hvd.grouped_allreduce_async(grads, name=f"at{i}")
+                for h in hs:
+                    hvd.synchronize(h)
+            assert st.parameter_manager.n_samples_taken >= 1 or \
+                not st.parameter_manager.active
+        finally:
+            for k in ("HOROVOD_AUTOTUNE", "HOROVOD_AUTOTUNE_WARMUP_SAMPLES",
+                      "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE",
+                      "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"):
+                os.environ.pop(k, None)
+            hvd.shutdown()
+            hvd.init()

@@ -1,0 +1,134 @@
+"""SyncBatchNorm + training callbacks tests.
+
+Reference models: torch/sync_batch_norm.py (stat merge math),
+_keras/callbacks.py (LR schedule/warmup, metric averaging, broadcast).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.callbacks import (BestModelCheckpoint, BroadcastGlobalVariablesCallback,
+                                   CallbackList, LearningRateScheduleCallback,
+                                   LearningRateWarmupCallback,
+                                   MetricAverageCallback, TrainLoopState)
+from horovod_tpu.ops.sync_batch_norm import SyncBatchNorm, sync_batch_stats
+
+
+@pytest.fixture(scope="module", autouse=True)
+def init_hvd():
+    hvd.init()
+    yield
+
+
+class TestSyncBatchStats:
+    def test_matches_global_batch(self, mesh8):
+        """Stats psum'd over 8 shards == stats of the unsharded batch."""
+        from jax import shard_map
+        rng = np.random.RandomState(0)
+        x = rng.rand(16, 4).astype(np.float32) * 3 + 1
+        garr = jax.device_put(jnp.asarray(x),
+                              NamedSharding(mesh8, P("world")))
+
+        def body(blk):
+            m, v = sync_batch_stats(blk, "world", (0,))
+            return m[None], v[None]
+
+        fn = jax.jit(shard_map(body, mesh=mesh8, in_specs=P("world"),
+                               out_specs=(P("world"), P("world"))))
+        mean, var = fn(garr)
+        np.testing.assert_allclose(np.asarray(mean)[0], x.mean(0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(var)[0], x.var(0),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestSyncBatchNormModule:
+    def test_normalizes_and_tracks_stats(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.rand(32, 8).astype(np.float32) * 5 - 2)
+        bn = SyncBatchNorm(use_running_average=False, axis_name=None,
+                           momentum=0.5)
+        variables = bn.init(jax.random.PRNGKey(0), x)
+        y, mutated = bn.apply(variables, x, mutable=["batch_stats"])
+        y = np.asarray(y)
+        np.testing.assert_allclose(y.mean(0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(y.std(0), 1.0, atol=1e-2)
+        # running stats moved toward batch stats
+        rm = np.asarray(mutated["batch_stats"]["mean"])
+        assert not np.allclose(rm, 0.0)
+
+    def test_inference_uses_running_stats(self):
+        x = jnp.ones((4, 8), jnp.float32)
+        bn = SyncBatchNorm(use_running_average=True)
+        variables = bn.init(jax.random.PRNGKey(0), x)
+        y = bn.apply(variables, x)
+        # running mean=0, var=1 at init → y == x (scale=1, bias=0)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x), atol=1e-5)
+
+    def test_cross_replica_inside_shard_map(self, mesh8):
+        """Each shard normalizes with GLOBAL statistics."""
+        from jax import shard_map
+        rng = np.random.RandomState(2)
+        x = rng.rand(16, 8).astype(np.float32)
+        # make shard means very different so local-only BN would differ
+        x[:8] += 10.0
+        garr = jax.device_put(jnp.asarray(x),
+                              NamedSharding(mesh8, P("world")))
+        bn = SyncBatchNorm(use_running_average=False, axis_name="world")
+        variables = bn.init(jax.random.PRNGKey(0), x[:2])
+
+        def body(blk):
+            y, _ = bn.apply(variables, blk, mutable=["batch_stats"])
+            return y
+
+        fn = jax.jit(shard_map(body, mesh=mesh8, in_specs=P("world"),
+                               out_specs=P("world")))
+        y = np.asarray(fn(garr))
+        expected = (x - x.mean(0)) / np.sqrt(x.var(0) + 1e-5)
+        np.testing.assert_allclose(y, expected, rtol=1e-3, atol=1e-3)
+
+
+class TestCallbacks:
+    def test_lr_warmup_ramp(self):
+        cb = LearningRateWarmupCallback(warmup_epochs=4, size=8)
+        state = TrainLoopState()
+        scales = []
+        for epoch in range(6):
+            state.epoch = epoch
+            cb.on_epoch_begin(state)
+            scales.append(state.lr_scale)
+        assert scales[0] == pytest.approx(1.0 / 8)
+        assert scales[4] == pytest.approx(1.0)
+        assert all(b >= a for a, b in zip(scales, scales[1:]))
+
+    def test_lr_schedule_staircase(self):
+        cb = LearningRateScheduleCallback(
+            multiplier=lambda e: 0.1 ** (e // 2), start_epoch=0)
+        state = TrainLoopState()
+        state.epoch = 3
+        cb.on_epoch_begin(state)
+        assert state.lr_scale == pytest.approx(0.1)
+
+    def test_metric_average_single_rank(self):
+        cb = MetricAverageCallback()
+        logs = {"loss": 2.0}
+        cb.on_epoch_end(TrainLoopState(), logs)
+        assert logs["loss"] == 2.0
+
+    def test_broadcast_and_checkpoint(self, tmp_path):
+        params = {"w": jnp.full((3,), 2.0)}
+        state = TrainLoopState(params=params)
+        CallbackList([BroadcastGlobalVariablesCallback(0)]).on_train_begin(state)
+        np.testing.assert_allclose(np.asarray(state.params["w"]), 2.0)
+
+        ckpt = BestModelCheckpoint(str(tmp_path / "best.pkl"),
+                                   monitor="loss", mode="min")
+        ckpt.on_epoch_end(state, {"loss": 1.0})
+        ckpt.on_epoch_end(state, {"loss": 2.0})  # no improvement
+        import pickle
+        with open(tmp_path / "best.pkl", "rb") as f:
+            saved = pickle.load(f)
+        assert saved["loss"] == 1.0

@@ -1,0 +1,249 @@
+"""Elastic driver logic tests — no real hosts, no subprocesses.
+
+Mirrors reference ``test/test_elastic_driver.py``: drive ElasticDriver with
+FixedHosts fake discovery and mock worker exits; assert rank assignment,
+failure barriers, blacklisting, scale up/down, and reset limits.
+"""
+
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.elastic.discovery import (FixedHosts, HostManager,
+                                           HostUpdateResult)
+from horovod_tpu.elastic.driver import ElasticDriver
+from horovod_tpu.elastic.rendezvous import ElasticRendezvousServer
+
+
+def wait_until(cond, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class MockWorkers:
+    """Collects create_worker_fn calls; lets tests 'run' workers manually."""
+
+    def __init__(self, driver_ref):
+        self.driver_ref = driver_ref
+        self.started = []
+        self.lock = threading.Lock()
+
+    def create(self, slot):
+        with self.lock:
+            self.started.append(slot)
+
+    def started_keys(self):
+        with self.lock:
+            return [(s.hostname, s.local_rank) for s in self.started]
+
+
+def make_driver(hosts, min_np, max_np=None, reset_limit=None, timeout=5.0):
+    discovery = FixedHosts(hosts)
+    server = ElasticRendezvousServer()
+    server.start()
+    driver = ElasticDriver(server, discovery, min_np=min_np, max_np=max_np,
+                           timeout=timeout, reset_limit=reset_limit)
+    server.set_driver(driver)
+    workers = MockWorkers(driver)
+    return driver, server, discovery, workers
+
+
+class TestHostManager:
+    def test_update_and_order(self):
+        disc = FixedHosts({"a": 2})
+        hm = HostManager(disc)
+        assert hm.update_available_hosts() == HostUpdateResult.ADDED
+        disc.set({"a": 2, "b": 2})
+        assert hm.update_available_hosts() == HostUpdateResult.ADDED
+        # seniority order preserved
+        assert [h.hostname for h in hm.current_hosts()] == ["a", "b"]
+        disc.set({"b": 2, "a": 2})
+        assert hm.update_available_hosts() == HostUpdateResult.NO_UPDATE
+        assert [h.hostname for h in hm.current_hosts()] == ["a", "b"]
+
+    def test_removal_and_slot_change(self):
+        disc = FixedHosts({"a": 2, "b": 2})
+        hm = HostManager(disc)
+        hm.update_available_hosts()
+        disc.set({"a": 2})
+        assert hm.update_available_hosts() == HostUpdateResult.REMOVED
+        disc.set({"a": 4})
+        assert hm.update_available_hosts() & HostUpdateResult.MIXED
+
+    def test_blacklist(self):
+        disc = FixedHosts({"a": 2, "b": 2})
+        hm = HostManager(disc)
+        hm.update_available_hosts()
+        hm.blacklist("b")
+        assert hm.is_blacklisted("b")
+        assert hm.available_slots() == 2
+        # blacklisted hosts never come back
+        hm.update_available_hosts()
+        assert [h.hostname for h in hm.current_hosts()] == ["a"]
+
+
+class TestElasticDriver:
+    def test_initial_world(self):
+        driver, server, disc, workers = make_driver({"a": 2, "b": 2}, 4)
+        try:
+            driver.start(4, workers.create)
+            assert driver.world_size() == 4
+            assert len(workers.started) == 4
+            # host-major rank assignment, stable ordering
+            s = driver.get_slot_info("a", 0)
+            assert s.rank == 0 and s.size == 4
+            s = driver.get_slot_info("b", 1)
+            assert s.rank == 3 and s.cross_rank == 1
+        finally:
+            driver.stop()
+            server.stop()
+
+    def test_failure_triggers_resume_and_restart(self):
+        driver, server, disc, workers = make_driver({"a": 2, "b": 2}, 2,
+                                                    max_np=4)
+        try:
+            driver.start(2, workers.create)
+            v1 = driver.world_version
+            # b:1 dies
+            driver.record_worker_exit("b", 1, exit_code=1)
+            assert driver.resume_needed()
+            assert driver.get_slot_info("a", 0) is None  # plan is frozen
+            # survivors re-rendezvous
+            for host, lr in [("a", 0), ("a", 1), ("b", 0)]:
+                driver.record_ready(host, lr)
+            assert wait_until(lambda: driver.world_version > v1)
+            assert wait_until(lambda: not driver.resume_needed())
+            # b still discoverable → not blacklisted; failed slot restarted
+            assert not driver.host_manager.is_blacklisted("b")
+            assert driver.world_size() == 4
+            assert wait_until(
+                lambda: workers.started_keys().count(("b", 1)) == 2)
+        finally:
+            driver.stop()
+            server.stop()
+
+    def test_dead_host_blacklisted_and_world_shrinks(self):
+        driver, server, disc, workers = make_driver({"a": 2, "b": 2}, 2,
+                                                    max_np=4)
+        try:
+            driver.start(4, workers.create)
+            v1 = driver.world_version
+            disc.set({"a": 2})          # b vanishes from discovery
+            driver.record_worker_exit("b", 0, exit_code=1)
+            driver.record_worker_exit("b", 1, exit_code=1)
+            for host, lr in [("a", 0), ("a", 1)]:
+                driver.record_ready(host, lr)
+            assert wait_until(lambda: driver.world_version > v1)
+            assert driver.host_manager.is_blacklisted("b")
+            assert driver.world_size() == 2
+            s = driver.get_slot_info("a", 1)
+            assert s.rank == 1 and s.size == 2
+        finally:
+            driver.stop()
+            server.stop()
+
+    def test_scale_up_on_new_host(self):
+        driver, server, disc, workers = make_driver({"a": 2}, 2, max_np=8)
+        try:
+            driver.start(2, workers.create)
+            v1 = driver.world_version
+            disc.set({"a": 2, "c": 2})
+            # discovery thread notices (≤ ~1s), marks pending
+            assert wait_until(driver.resume_needed, timeout=5)
+            driver.record_ready("a", 0)
+            driver.record_ready("a", 1)
+            assert wait_until(lambda: driver.world_version > v1)
+            assert driver.world_size() == 4
+            assert wait_until(
+                lambda: ("c", 0) in workers.started_keys() and
+                        ("c", 1) in workers.started_keys())
+        finally:
+            driver.stop()
+            server.stop()
+
+    def test_no_resume_beyond_max_np(self):
+        driver, server, disc, workers = make_driver({"a": 2}, 2, max_np=2)
+        try:
+            driver.start(2, workers.create)
+            disc.set({"a": 2, "c": 2})
+            time.sleep(2.5)  # give discovery thread time to (not) react
+            assert not driver.resume_needed()
+            assert driver.world_size() == 2
+        finally:
+            driver.stop()
+            server.stop()
+
+    def test_reset_limit(self):
+        driver, server, disc, workers = make_driver({"a": 2}, 2,
+                                                    reset_limit=1)
+        try:
+            driver.start(2, workers.create)
+            v1 = driver.world_version
+            # first failure: allowed reset
+            driver.record_worker_exit("a", 1, exit_code=1)
+            driver.record_ready("a", 0)
+            assert wait_until(lambda: driver.world_version > v1)
+            # second failure: exceeds limit → job stops with error
+            driver.record_worker_exit("a", 1, exit_code=1)
+            driver.record_ready("a", 0)
+            assert wait_until(driver.finished)
+            assert "reset limit" in (driver.error_message or "")
+        finally:
+            driver.stop()
+            server.stop()
+
+    def test_all_success_finishes(self):
+        driver, server, disc, workers = make_driver({"a": 2}, 2)
+        try:
+            driver.start(2, workers.create)
+            driver.record_worker_exit("a", 0, exit_code=0)
+            driver.record_worker_exit("a", 1, exit_code=0)
+            assert wait_until(driver.finished)
+            assert driver.error_message is None
+        finally:
+            driver.stop()
+            server.stop()
+
+    def test_wait_for_slots_timeout(self):
+        driver, server, disc, workers = make_driver({}, 2, timeout=2.0)
+        try:
+            with pytest.raises(TimeoutError):
+                driver.wait_for_available_slots(2)
+        finally:
+            driver.stop()
+            server.stop()
+
+
+class TestElasticRendezvous:
+    def test_get_records_ready_and_serves_slots(self):
+        driver, server, disc, workers = make_driver({"a": 2}, 2)
+        try:
+            driver.start(2, workers.create)
+            from horovod_tpu.runner.http_client import read_data_from_kvstore
+            from horovod_tpu.runner.hosts import SlotInfo
+            data = read_data_from_kvstore("127.0.0.1", server.port,
+                                          "rank_and_size", "a:1", timeout=5)
+            slot = SlotInfo.from_response_string(data.decode())
+            assert slot.rank == 1 and slot.size == 2
+            assert driver.registry.count("READY") >= 1
+        finally:
+            driver.stop()
+            server.stop()
+
+    def test_worker_addresses_roundtrip(self):
+        driver, server, disc, workers = make_driver({"a": 2}, 2)
+        try:
+            driver.start(2, workers.create)
+            from horovod_tpu.runner.http_client import put_data_into_kvstore
+            put_data_into_kvstore("127.0.0.1", server.port,
+                                  "worker_addresses", "0",
+                                  b"127.0.0.1:9999")
+            assert server.worker_addresses() == {"0": "127.0.0.1:9999"}
+        finally:
+            driver.stop()
+            server.stop()

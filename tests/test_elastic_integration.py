@@ -1,0 +1,153 @@
+"""End-to-end elastic integration on localhost — real worker processes, a
+scripted discovery source whose output changes mid-run, full re-rendezvous.
+
+Mirrors the reference's ``test/integration/elastic_common.py`` design
+(discovery scripts whose output changes over time, elastic_common.py:33-52,
+host add/remove runs :118-246), on the JAX CPU multi-process world.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("HVD_TPU_SKIP_MULTIPROC") == "1",
+    reason="multi-process tier disabled")
+
+
+WORKER_SRC = r"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import horovod_tpu as hvd
+
+TOTAL = int(os.environ["TEST_TOTAL_BATCHES"])
+OUT = os.environ["TEST_OUT_DIR"]
+
+hvd.init()
+state = hvd.elastic.ObjectState(batch=0)
+
+
+@hvd.elastic.run
+def train(state):
+    while state.batch < TOTAL:
+        out = np.asarray(hvd.allreduce(np.ones(2), name=f"b{state.batch}",
+                                       op=hvd.Sum))
+        assert out[0] == hvd.size(), (out, hvd.size())
+        state.batch += 1
+        state.commit()
+        time.sleep(float(os.environ.get("TEST_BATCH_SLEEP", "0.1")))
+    return {"rank": hvd.rank(), "size": hvd.size(), "batch": state.batch}
+
+
+result = train(state)
+if result is not None:
+    path = os.path.join(OUT, f"done_{result['rank']}_{os.getpid()}.json")
+    with open(path, "w") as f:
+        json.dump(result, f)
+else:
+    path = os.path.join(OUT, f"removed_{os.getpid()}.json")
+    with open(path, "w") as f:
+        json.dump({"removed": True}, f)
+hvd.shutdown()
+"""
+
+
+def _worker_env(tmp_path, total, sleep="0.1"):
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "HOROVOD_STALL_CHECK_DISABLE": "1",
+        "HOROVOD_GLOO_TIMEOUT_SECONDS": "90",
+        "TEST_OUT_DIR": str(tmp_path / "out"),
+        "TEST_TOTAL_BATCHES": str(total),
+        "TEST_BATCH_SLEEP": sleep,
+    })
+    return env
+
+
+def _launch(tmp_path, hosts_text, np_, max_np, total_batches):
+    from horovod_tpu.elastic.discovery import HostDiscoveryScript
+    from horovod_tpu.elastic.launcher import launch_elastic_job
+
+    hostsfile = tmp_path / "hosts.txt"
+    hostsfile.write_text(hosts_text)
+    script = tmp_path / "train.py"
+    script.write_text(WORKER_SRC)
+    (tmp_path / "out").mkdir()
+
+    discovery = HostDiscoveryScript(f"cat {hostsfile}")
+    env = _worker_env(tmp_path, total_batches)
+    errors = []
+
+    def _run():
+        try:
+            launch_elastic_job(discovery, np_, [sys.executable, str(script)],
+                               base_env=env, min_np=np_, max_np=max_np,
+                               timeout=120)
+        except Exception as e:  # surfaced in the asserting test thread
+            errors.append(e)
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    return hostsfile, t, errors
+
+
+def _done_results(tmp_path):
+    import json
+    out = tmp_path / "out"
+    results = []
+    for p in sorted(out.glob("done_*.json")):
+        with open(p) as f:
+            results.append(json.load(f))
+    return results
+
+
+@pytest.mark.integration
+def test_elastic_scale_up(tmp_path):
+    """2 workers start; a third slot appears mid-run; all finish at size 3."""
+    hostsfile, t, errors = _launch(tmp_path, "localhost:2\n",
+                                   np_=2, max_np=3, total_batches=120)
+    # let the first world make progress, then add a slot
+    time.sleep(8)
+    hostsfile.write_text("localhost:3\n")
+    t.join(timeout=180)
+    assert not t.is_alive(), "elastic job did not finish"
+    assert not errors, errors
+    results = _done_results(tmp_path)
+    assert len(results) == 3, results
+    assert all(r["size"] == 3 for r in results), results
+    assert all(r["batch"] == 120 for r in results), results
+    assert sorted(r["rank"] for r in results) == [0, 1, 2]
+
+
+@pytest.mark.integration
+def test_elastic_scale_down(tmp_path):
+    """3 workers start; one slot is scaled away mid-run; the removed worker
+    exits cleanly and the remaining two finish at size 2."""
+    hostsfile, t, errors = _launch(tmp_path, "localhost:3\n",
+                                   np_=2, max_np=3, total_batches=120)
+    time.sleep(8)
+    hostsfile.write_text("localhost:2\n")
+    t.join(timeout=180)
+    assert not t.is_alive(), "elastic job did not finish"
+    assert not errors, errors
+    results = _done_results(tmp_path)
+    assert len(results) == 2, results
+    assert all(r["size"] == 2 for r in results), results
+    assert all(r["batch"] == 120 for r in results), results
+    removed = list((tmp_path / "out").glob("removed_*.json"))
+    assert len(removed) == 1, removed

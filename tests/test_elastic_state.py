@@ -1,0 +1,170 @@
+"""Elastic state, run-loop, and notification tests (single-process world).
+
+Mirrors the reference's state contract tests: commit/restore/sync semantics
+(common/elastic.py:26-144), the run_fn retry loop (:147-168), and the worker
+notification round trip (runner/elastic/worker.py).
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.common.exceptions import (HorovodInternalError,
+                                           HostsUpdatedInterrupt)
+from horovod_tpu.elastic import (ObjectState, TPUState, run_fn,
+                                 HostUpdateResult)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def init_hvd():
+    hvd.init()
+    yield
+
+
+class TestObjectState:
+    def test_save_restore(self):
+        state = ObjectState(batch=0, epoch=0)
+        state.batch = 5
+        state.commit()
+        state.batch = 9
+        state.restore()
+        assert state.batch == 5 and state.epoch == 0
+
+    def test_sync_noop_single(self):
+        state = ObjectState(batch=3)
+        state.sync()
+        assert state.batch == 3
+
+    def test_reset_callbacks(self):
+        calls = []
+        state = ObjectState(batch=0)
+        state.register_reset_callbacks([lambda: calls.append(1)])
+        state.on_reset()
+        assert calls == [1]
+
+
+class TestTPUState:
+    def test_pytree_save_restore(self):
+        params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+        state = TPUState(params=params, batch=0)
+        state.commit()
+        state.params = {"w": jnp.full((4, 4), 7.0), "b": jnp.ones((4,))}
+        state.batch = 3
+        state.restore()
+        np.testing.assert_allclose(np.asarray(state.params["w"]), 1.0)
+        assert state.batch == 0
+
+    def test_sync_broadcasts(self):
+        params = {"w": jnp.arange(4.0)}
+        state = TPUState(params=params, step=2)
+        state.sync()
+        np.testing.assert_allclose(np.asarray(state.params["w"]),
+                                   [0, 1, 2, 3])
+        assert state.step == 2
+
+    def test_host_update_interrupt_at_commit(self):
+        state = ObjectState(batch=0)
+        state.on_hosts_updated(int(time.time() * 1e6),
+                               HostUpdateResult.ADDED)
+        with pytest.raises(HostsUpdatedInterrupt) as ei:
+            state.commit()
+        assert ei.value.skip_sync  # additions only → state still valid
+        # a mixed update does not skip sync
+        state.on_hosts_updated(int(time.time() * 1e6) + 1,
+                               HostUpdateResult.MIXED)
+        with pytest.raises(HostsUpdatedInterrupt) as ei:
+            state.commit()
+        assert not ei.value.skip_sync
+
+
+class TestRunLoop:
+    def _state(self):
+        class FakeState(ObjectState):
+            def __init__(self):
+                self.syncs = 0
+                self.restores = 0
+                super().__init__(batch=0)
+
+            def sync(self):
+                self.syncs += 1
+                super().sync()
+
+            def restore(self):
+                self.restores += 1
+                super().restore()
+        return FakeState()
+
+    def test_returns_result(self):
+        state = self._state()
+        resets = []
+        wrapped = run_fn(lambda s: "done", lambda: resets.append(1))
+        assert wrapped(state) == "done"
+        assert state.syncs == 1 and resets == []
+
+    def test_internal_error_restores_and_retries(self):
+        state = self._state()
+        resets = []
+        attempts = []
+
+        def train(s):
+            attempts.append(1)
+            if len(attempts) == 1:
+                raise HorovodInternalError("peer died")
+            return "ok"
+
+        wrapped = run_fn(train, lambda: resets.append(1))
+        assert wrapped(state) == "ok"
+        assert state.restores == 1
+        assert len(resets) == 1
+        assert state.syncs == 2  # initial + after restore
+
+    def test_hosts_updated_skips_sync_on_add(self):
+        state = self._state()
+        attempts = []
+
+        def train(s):
+            attempts.append(1)
+            if len(attempts) == 1:
+                raise HostsUpdatedInterrupt(skip_sync=True)
+            return "ok"
+
+        wrapped = run_fn(train, lambda: None)
+        assert wrapped(state) == "ok"
+        assert state.restores == 0
+        assert state.syncs == 1  # skip_sync honored
+
+
+class TestNotificationRoundtrip:
+    def test_driver_push_reaches_listener(self):
+        from horovod_tpu.elastic.worker import (WorkerNotificationManager,
+                                                WorkerNotificationClient)
+        from horovod_tpu.runner.http_server import KVStoreServer
+
+        rdv = KVStoreServer()
+        rdv.start()
+        try:
+            mgr = WorkerNotificationManager()
+            mgr.init(rendezvous_addr="127.0.0.1", rendezvous_port=rdv.port,
+                     rank=0, hostname="127.0.0.1")
+            events = []
+
+            class Listener:
+                def on_hosts_updated(self, ts, res):
+                    events.append((ts, res))
+
+            mgr.register_listener(Listener())
+            # the driver reads the advertised address from the KV store
+            with rdv._lock:
+                addr = rdv._store["worker_addresses"]["0"].decode()
+            WorkerNotificationClient(addr).notify_hosts_updated(
+                42, HostUpdateResult.REMOVED)
+            deadline = time.monotonic() + 5
+            while not events and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert events == [(42, HostUpdateResult.REMOVED)]
+            mgr.shutdown()
+        finally:
+            rdv.stop()
