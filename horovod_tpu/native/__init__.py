@@ -1,0 +1,106 @@
+"""Native (C++) runtime layer, loaded via ctypes.
+
+The reference's runtime core is C++ (horovod/common/*.cc) compiled by
+setup.py into a framework extension. Here the native layer is a plain shared
+library (no pybind11 in the image) built from ``native/src/*.cc`` with g++ and
+loaded through ctypes:
+
+- ``timeline.cc`` — the Chrome-trace writer thread (parity:
+  common/timeline.{h,cc}): Python pushes events through a C API; a dedicated
+  C++ thread owns the file so the hot enqueue path never blocks on IO.
+
+Build strategy: ``setup.py``'s build step pre-compiles the library; if it is
+missing (editable install, fresh checkout) :func:`load` compiles it on demand
+into the package directory and caches the result. Loading is best-effort —
+callers must fall back to their Python implementations when ``load`` returns
+None (no compiler, read-only install, exotic platform).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_LOG = logging.getLogger("horovod_tpu.native")
+
+_SRC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "src")
+_LIB_NAME = "libhorovod_tpu_native.so"
+_SOURCES = ("timeline.cc",)
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def lib_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), _LIB_NAME)
+
+
+def sources():
+    return [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+
+
+def build(out_path: Optional[str] = None, quiet: bool = True) -> str:
+    """Compile the native library with g++. Raises on failure.
+
+    Used both by setup.py (pre-build at install time) and by :func:`load`
+    (on-demand build for editable installs).
+    """
+    out_path = out_path or lib_path()
+    srcs = sources()
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if os.path.exists(out_path) and os.path.getmtime(out_path) >= newest_src:
+        return out_path
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
+           "-o", out_path] + srcs
+    res = subprocess.run(cmd, capture_output=True, text=True)
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"native build failed ({' '.join(cmd)}):\n{res.stderr}")
+    if not quiet:
+        _LOG.info("built %s", out_path)
+    return out_path
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.hvd_timeline_open.argtypes = [ctypes.c_char_p]
+    lib.hvd_timeline_open.restype = ctypes.c_int
+    lib.hvd_timeline_event.argtypes = [
+        ctypes.c_char, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_char_p]
+    lib.hvd_timeline_event.restype = None
+    lib.hvd_timeline_close.argtypes = []
+    lib.hvd_timeline_close.restype = None
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None when unavailable."""
+    global _lib, _load_attempted
+    with _lock:
+        if _lib is not None or _load_attempted:
+            return _lib
+        _load_attempted = True
+        try:
+            path = lib_path()
+            try:
+                # build() is an mtime-checked no-op when the .so is fresh;
+                # this keeps editable checkouts honest after source edits.
+                path = build(path)
+            except Exception:
+                if not os.path.exists(path):
+                    raise  # no compiler AND no prebuilt library
+            _lib = _bind(ctypes.CDLL(path))
+        except Exception as e:  # missing g++, RO filesystem, etc.
+            _LOG.debug("native layer unavailable, using Python fallbacks: %r", e)
+            _lib = None
+        return _lib
+
+
+def built() -> bool:
+    """Introspection hook (parity: common/basics.py *_built)."""
+    return load() is not None

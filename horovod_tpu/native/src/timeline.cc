@@ -104,6 +104,11 @@ class TimelineWriter {
       line += dur;
     }
     line += ",\"name\":\"" + name + "\"";
+    if (e.ph == 'i') {
+      // instant events are global-scope (full-height marks), matching the
+      // Python writer's {"s":"g"}
+      line += ",\"s\":\"g\"";
+    }
     if (e.ph == 'M') {
       // metadata events name threads: args = {"name": <name>}
       line += ",\"args\":{\"name\":\"" + name + "\"}";

@@ -7,8 +7,11 @@ boost lock-free SPSC queue + writer thread (timeline.h:66-75), here a
 NEGOTIATING phase, controller.cc:809-821 — SPMD needs no negotiation so the
 span covers enqueue→completion) then the op activity span.
 
-A C++ writer with the same wire format lives in native/ (Slice 6); this Python
-writer is the fallback and the reference implementation for tests.
+The hot path writes through the native C++ writer (native/src/timeline.cc,
+loaded via ctypes — the parity analog of the reference's writer thread) when
+the native library is available; this Python writer thread is the fallback.
+Set ``HOROVOD_TIMELINE_NATIVE=0`` to force the Python writer (tests exercise
+both).
 """
 
 from __future__ import annotations
@@ -25,6 +28,11 @@ _AUTO_NAME_RE = re.compile(r"\.noname\.\d+$")
 _MAX_TIDS = 4096
 
 
+def _native_enabled() -> bool:
+    return os.environ.get("HOROVOD_TIMELINE_NATIVE", "1").strip().lower() \
+        not in ("0", "false", "no", "off")
+
+
 class Timeline:
     def __init__(self, path: str, mark_cycles: bool = False):
         self.path = path
@@ -36,24 +44,45 @@ class Timeline:
         self._pending = {}
         self._tids = {}
         self._next_tid = 1
+        self._native = None  # ctypes lib when the C++ writer owns the file
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self):
         if self._running:
             return
+        if _native_enabled():
+            from . import native
+            lib = native.load()
+            # The native writer is a process-wide singleton (one open file);
+            # a second concurrent Timeline falls back to the Python writer.
+            if lib is not None:
+                d = os.path.dirname(self.path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                if lib.hvd_timeline_open(self.path.encode()) == 0:
+                    self._native = lib
         self._running = True
-        self._thread = threading.Thread(target=self._writer, name="hvd-timeline",
-                                        daemon=True)
-        self._thread.start()
+        if self._native is None:
+            self._thread = threading.Thread(target=self._writer,
+                                            name="hvd-timeline", daemon=True)
+            self._thread.start()
 
     def stop(self):
         if not self._running:
             return
         self._running = False
+        if self._native is not None:
+            self._native.hvd_timeline_close()
+            self._native = None
+            return
         self._q.put(None)
         self._thread.join(timeout=5)
         self._thread = None
+
+    @property
+    def native_active(self) -> bool:
+        return self._native is not None
 
     # -- event recording (any thread) -------------------------------------
 
@@ -76,22 +105,42 @@ class Timeline:
         return tid
 
     def record_enqueue(self, name: str, kind: str, nbytes: int):
+        if self._native is not None:
+            args = json.dumps({"tensor": name, "bytes": nbytes})
+            self._native.hvd_timeline_event(
+                b"B", kind.upper().encode(), int(self._ts_us()), 0,
+                self._tid(name), args.encode())
+            return
         self._q.put({"name": kind.upper(), "ph": "B", "ts": self._ts_us(),
                      "pid": 0, "tid": self._tid(name),
                      "args": {"tensor": name, "bytes": nbytes}})
 
     def record_done(self, name: str):
+        if self._native is not None:
+            self._native.hvd_timeline_event(
+                b"E", b"", int(self._ts_us()), 0, self._tid(name), None)
+            return
         self._q.put({"name": "", "ph": "E", "ts": self._ts_us(),
                      "pid": 0, "tid": self._tid(name)})
 
     def record_activity(self, name: str, activity: str, dur_us: float):
+        if self._native is not None:
+            self._native.hvd_timeline_event(
+                b"X", activity.encode(), int(self._ts_us() - dur_us),
+                int(dur_us), self._tid(name), None)
+            return
         self._q.put({"name": activity, "ph": "X", "ts": self._ts_us() - dur_us,
                      "dur": dur_us, "pid": 0, "tid": self._tid(name)})
 
     def mark_cycle(self):
-        if self.mark_cycles:
-            self._q.put({"name": "CYCLE", "ph": "i", "ts": self._ts_us(),
-                         "pid": 0, "tid": 0, "s": "g"})
+        if not self.mark_cycles:
+            return
+        if self._native is not None:
+            self._native.hvd_timeline_event(
+                b"i", b"CYCLE", int(self._ts_us()), 0, 0, None)
+            return
+        self._q.put({"name": "CYCLE", "ph": "i", "ts": self._ts_us(),
+                     "pid": 0, "tid": 0, "s": "g"})
 
     # -- writer thread -----------------------------------------------------
 
