@@ -2,11 +2,24 @@
 
 Mirrors the reference's synthetic benchmark scripts
 (examples/tensorflow2_synthetic_benchmark.py, pytorch_synthetic_benchmark.py:
-ResNet-50, synthetic ImageNet data, images/sec). Metric: images/sec/chip on
-the available TPU chip(s). Baseline: the reference's only published absolute
-throughput, ResNet-101 synthetic at 1656.82 img/s on 16 Pascal P100s
-(docs/benchmarks.rst:40-46) → 103.55 img/s/GPU; vs_baseline is our
-per-chip ResNet-50 throughput over that number.
+ResNet-50, synthetic ImageNet data, images/sec) but, unlike a raw-JAX
+benchmark, the measured train step routes gradients THROUGH the framework:
+
+- **spmd** (headline): shard_map'd train step over the chip mesh whose
+  gradient reduction is ``horovod_tpu.optimizer.distributed`` (bucketed
+  ``allreduce_p`` psum over the 'data' axis) — the TPU-native hot path.
+- **raw** (control): identical step with plain optax and no framework in the
+  loop; ``overhead_pct`` = (raw - spmd) / raw.
+- **eager**: gradients leave the jitted step and are reduced through the
+  engine (``grouped_allreduce``: handle manager, fusion bucketing, stacked
+  collective builders) — the Horovod-style process-parallel path.
+
+Reported: images/sec/chip, step time, achieved TFLOP/s (XLA cost analysis
+when available, else the ResNet-50 analytic ~3x4.1 GFLOPs/image), MFU vs chip
+peak, and framework overhead vs the raw control. ``vs_baseline`` compares
+per-chip throughput against the reference's only published absolute number:
+ResNet-101 synthetic, 1656.82 img/s on 16 Pascal P100s (docs/benchmarks.rst:
+40-46) -> 103.55 img/s/GPU.
 """
 
 from __future__ import annotations
@@ -16,6 +29,66 @@ import os
 import time
 
 BASELINE_IMG_S_PER_CHIP = 1656.82 / 16.0
+RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.1e9  # fwd ~4.1 GFLOPs, train ~3x
+
+# bf16 peak TFLOP/s per chip by device kind (public spec sheets)
+_PEAK_TFLOPS = (
+    ("v6 lite", 918.0), ("v6e", 918.0),
+    ("v5 lite", 197.0), ("v5e", 197.0),
+    ("v5p", 459.0), ("v5", 459.0),
+    ("v4", 275.0), ("v3", 123.0), ("v2", 45.0),
+)
+
+
+def _chip_peak_tflops(device) -> float | None:
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in _PEAK_TFLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def _fetch_scalar(x):
+    """Force execution by pulling a scalar to the host. On the tunneled TPU
+    backend ``block_until_ready`` returns before the device has executed; a
+    host read is the only reliable completion barrier."""
+    import numpy as np
+    return float(np.asarray(x).reshape(-1)[0])
+
+
+def _measure_rtt(sample):
+    """One-way cost of a host fetch of already-computed data (tunnel RTT +
+    transfer), subtracted from timed loops."""
+    _fetch_scalar(sample)
+    t0 = time.perf_counter()
+    _fetch_scalar(sample)
+    return time.perf_counter() - t0
+
+
+def _time_steps(fn, state, const_args, iters):
+    """Time ``iters`` *dependent* steps of ``fn(*state, *const_args) ->
+    (*new_state, loss)``: each iteration feeds the previous output state back
+    in (so the device cannot overlap or elide them), with a single scalar
+    fetch at the end as the completion barrier."""
+    # Two state-threading warmups: the first compiles for the initial
+    # (host/uncommitted) state shardings, the second for the steady-state
+    # (device-committed) shardings the timed loop actually runs with.
+    out = fn(*state, *const_args)
+    _fetch_scalar(out[-1])
+    out = fn(*out[:-1], *const_args)
+    _fetch_scalar(out[-1])
+    rtt = _measure_rtt(out[-1])
+    state = out[:-1]
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*state, *const_args)
+        state = out[:-1]
+    _fetch_scalar(out[-1])
+    dt = time.perf_counter() - t0 - rtt
+    return max(dt, 1e-9) / iters, rtt
 
 
 def main():
@@ -23,19 +96,20 @@ def main():
     import jax
     import jax.numpy as jnp
     import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax import shard_map
 
+    import horovod_tpu as hvd
+    from horovod_tpu import optimizer as hvd_opt
     from horovod_tpu.models.resnet import ResNet50
 
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-    # Data-parallel over every visible chip (the reference benchmark is DP
-    # scaling); on a single chip this degenerates to plain jit.
     n_chips = max(1, len(jax.devices()))
     mesh = Mesh(np.array(jax.devices()), ("data",))
     data_sh = NamedSharding(mesh, P("data"))
     rep_sh = NamedSharding(mesh, P())
 
     batch = int(os.environ.get("BENCH_BATCH", "128")) * n_chips
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
     rng = jax.random.PRNGKey(0)
     images = jax.device_put(jnp.asarray(
@@ -44,12 +118,8 @@ def main():
         np.random.RandomState(1).randint(0, 1000, size=(batch,)), jnp.int32),
         data_sh)
 
-    variables = model.init(rng, images, train=True)
+    variables = model.init(rng, images[:2], train=True)
     params, batch_stats = variables["params"], variables["batch_stats"]
-    opt = optax.sgd(0.01, momentum=0.9)
-    opt_state = opt.init(params)
-    params, batch_stats, opt_state = jax.device_put(
-        (params, batch_stats, opt_state), rep_sh)
 
     def loss_fn(params, batch_stats, images, labels):
         logits, mutated = model.apply(
@@ -59,36 +129,119 @@ def main():
         loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
         return loss, mutated["batch_stats"]
 
+    # ---- raw-jit control (no framework in the loop) -----------------------
+    raw_opt = optax.sgd(0.01, momentum=0.9)
+    raw_state = jax.device_put((params, batch_stats, raw_opt.init(params)), rep_sh)
+
     @jax.jit
-    def train_step(params, batch_stats, opt_state, images, labels):
+    def raw_step(params, batch_stats, opt_state, images, labels):
         (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, batch_stats, images, labels)
-        updates, opt_state = opt.update(grads, opt_state, params)
+        updates, opt_state = raw_opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, new_bs, opt_state, loss
 
-    # Warmup / compile
-    for _ in range(3):
-        params, batch_stats, opt_state, loss = train_step(
-            params, batch_stats, opt_state, images, labels)
-    jax.block_until_ready(loss)
+    raw_dt, rtt = _time_steps(raw_step, raw_state, (images, labels), iters)
 
-    iters = int(os.environ.get("BENCH_ITERS", "20"))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        params, batch_stats, opt_state, loss = train_step(
-            params, batch_stats, opt_state, images, labels)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    # ---- framework SPMD path (headline) -----------------------------------
+    # shard_map over the chip mesh; per-shard grads reduced by the
+    # framework's distributed optimizer (allreduce_p psum over 'data').
+    dist_opt = hvd_opt.distributed(optax.sgd(0.01, momentum=0.9),
+                                   axis_name="data", op=hvd.Average,
+                                   axis_size=n_chips)
 
-    img_s = batch * iters / dt
-    img_s_chip = img_s / n_chips
+    def spmd_body(params, batch_stats, opt_state, images, labels):
+        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch_stats, images, labels)
+        updates, opt_state = dist_opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        # batch_stats: average the per-shard EMA (SyncBatchNorm-style psum)
+        new_bs = jax.tree_util.tree_map(
+            lambda x: jax.lax.pmean(x, "data"), new_bs)
+        loss = jax.lax.pmean(loss, "data")
+        return params, new_bs, opt_state, loss
+
+    spmd_step = jax.jit(shard_map(
+        spmd_body, mesh=mesh,
+        in_specs=(P(), P(), P(), P("data"), P("data")),
+        out_specs=(P(), P(), P(), P())))
+    spmd_state = jax.device_put(
+        (params, batch_stats, dist_opt.init(params)), rep_sh)
+    spmd_dt, _ = _time_steps(spmd_step, spmd_state, (images, labels), iters)
+
+    # achieved FLOP/s from XLA's own cost model when available; its 'flops'
+    # is the PER-DEVICE SPMD module cost, so it needs no /n_chips
+    flops_per_chip = None
+    try:
+        cost = spmd_step.lower(*spmd_state, images, labels).compile() \
+            .cost_analysis()
+        if cost:
+            ca = cost[0] if isinstance(cost, (list, tuple)) else cost
+            f = float(ca.get("flops", 0.0))
+            if f > 1e9:
+                flops_per_chip = f
+    except Exception:
+        pass
+    if flops_per_chip is None:
+        flops_per_chip = RESNET50_TRAIN_FLOPS_PER_IMAGE * batch / n_chips
+
+    # ---- eager process-parallel path --------------------------------------
+    hvd.init()
+    eng = hvd._engine()
+    eager_opt = optax.sgd(0.01, momentum=0.9)
+    eager_opt_state = eager_opt.init(params)
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
+
+    @jax.jit
+    def apply_fn(params, opt_state, grads):
+        updates, opt_state = eager_opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    def eager_step(params, batch_stats, opt_state, images, labels):
+        (loss, new_bs), grads = grad_fn(params, batch_stats, images, labels)
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        # Route through the engine unconditionally (even at size 1) so the
+        # measured loop includes registration, fusion bucketing, and the
+        # stacked collective launch.
+        handles = eng.grouped_allreduce(leaves, name="bench.grad",
+                                        op=hvd.Average if hvd.size() > 1
+                                        else hvd.Sum)
+        reduced = jax.tree_util.tree_unflatten(
+            treedef, [h.synchronize() for h in handles])
+        params, opt_state = apply_fn(params, opt_state, reduced)
+        return params, new_bs, opt_state, loss
+
+    eager_dt, _ = _time_steps(eager_step,
+                              (params, batch_stats, eager_opt_state),
+                              (images, labels), max(iters // 4, 2))
+
+    # ---- report -----------------------------------------------------------
+    spmd_img_s = batch / spmd_dt
+    raw_img_s = batch / raw_dt
+    eager_img_s = batch / eager_dt
+    tflops_chip = flops_per_chip / spmd_dt / 1e12
+    peak = _chip_peak_tflops(jax.devices()[0])
+    img_s_chip = spmd_img_s / n_chips
     print(json.dumps({
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
         "value": round(img_s_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(img_s_chip / BASELINE_IMG_S_PER_CHIP, 3),
+        "n_chips": n_chips,
+        "batch_per_chip": batch // n_chips,
+        "step_time_ms": round(spmd_dt * 1e3, 3),
+        "raw_jit_img_s_per_chip": round(raw_img_s / n_chips, 2),
+        "framework_overhead_pct": round((raw_dt and
+                                         (spmd_dt - raw_dt) / raw_dt * 100), 2),
+        "eager_img_s_per_chip": round(eager_img_s / n_chips, 2),
+        "achieved_tflops_per_chip": round(tflops_chip, 2),
+        "mfu_pct": (round(100.0 * tflops_chip / peak, 2)
+                    if peak else None),
+        "tunnel_rtt_ms": round(rtt * 1e3, 2),
+        "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
     }))
+    hvd.shutdown()
 
 
 if __name__ == "__main__":

@@ -263,3 +263,9 @@ class Backend:
                 return s.data[0]
         # Fallback: single addressable shard
         return garr.addressable_shards[0].data[0]
+
+    def from_replicated(self, garr: jax.Array):
+        """Extract a replicated (out_specs=P()) result: the addressable shard
+        IS the full value — a zero-dispatch read (no eager slice, which would
+        cost a device round-trip per tensor)."""
+        return garr.addressable_shards[0].data

@@ -38,6 +38,41 @@ from ..parallel.mesh import WORLD_AXIS
 from .backend import Backend
 
 
+class LaunchGroup:
+    """Shared completion latch for every handle born from one fused launch.
+
+    All outputs of a single jitted program complete together, so readiness of
+    one representative output implies readiness of all — one is_ready /
+    block_until_ready RPC per *launch* instead of per tensor (the role of the
+    reference's single completion event per fused buffer,
+    gpu_operations.cc:47-87 FinalizeGPUQueue)."""
+
+    __slots__ = ("_rep", "_done", "_lock")
+
+    def __init__(self, representative: jax.Array):
+        self._rep = representative
+        self._done = False
+        self._lock = threading.Lock()
+
+    def ready(self) -> bool:
+        if self._done:
+            return True
+        try:
+            ok = self._rep.is_ready()
+        except AttributeError:  # older jax without is_ready
+            ok = True
+        if ok:
+            self._done = True
+        return ok
+
+    def wait(self):
+        if not self._done:
+            with self._lock:
+                if not self._done:
+                    self._rep.block_until_ready()
+                    self._done = True
+
+
 class Handle:
     """Async op handle. Readiness *is* the underlying jax.Array's readiness
     (replaces ReadyEvent + finalizer thread, gpu_operations.cc:47-87).
@@ -46,14 +81,15 @@ class Handle:
     table and feed the stall inspector/timeline."""
 
     __slots__ = ("name", "_garrs", "_extract", "_engine", "_done", "_result",
-                 "_finish_lock", "enqueue_time", "recv_sizes")
+                 "_finish_lock", "enqueue_time", "recv_sizes", "_group")
 
     def __init__(self, name: str, garrs: List[jax.Array], extract: Callable,
-                 engine: "Engine"):
+                 engine: "Engine", group: Optional[LaunchGroup] = None):
         self.name = name
         self._garrs = garrs
         self._extract = extract
         self._engine = engine
+        self._group = group
         self._done = False
         self._result = None
         self._finish_lock = threading.Lock()
@@ -63,18 +99,24 @@ class Handle:
     def poll(self) -> bool:
         if self._done:
             return True
-        try:
-            ready = all(g.is_ready() for g in self._garrs)
-        except AttributeError:  # older jax without is_ready
-            ready = True
+        if self._group is not None:
+            ready = self._group.ready()
+        else:
+            try:
+                ready = all(g.is_ready() for g in self._garrs)
+            except AttributeError:  # older jax without is_ready
+                ready = True
         if ready:
             self._finish()
         return self._done
 
     def synchronize(self):
         if not self._done:
-            for g in self._garrs:
-                g.block_until_ready()
+            if self._group is not None:
+                self._group.wait()
+            else:
+                for g in self._garrs:
+                    g.block_until_ready()
             self._finish()
         return self._result
 
@@ -197,8 +239,11 @@ class Engine:
         if self.on_done is not None:
             self.on_done(h.name)
 
-    def _single(self, name: str, garr: jax.Array) -> Handle:
-        h = Handle(name, [garr], lambda gs: self.backend.from_global(gs[0]), self)
+    def _single(self, name: str, garr: jax.Array,
+                replicated: bool = True) -> Handle:
+        extract = (self.backend.from_replicated if replicated
+                   else self.backend.from_global)
+        h = Handle(name, [garr], lambda gs: extract(gs[0]), self)
         self._track(name, h)
         return h
 
@@ -273,23 +318,38 @@ class Engine:
                                 "grouped_allreduce", t.nbytes)
                  for i, t in enumerate(tensors)]
         buckets = bucket_by_size(tensors, self.config.fusion_threshold_bytes)
-        fn = self._allreduce_builder(op, prescale_factor, postscale_factor)
+        mesh = self.backend.group_mesh
+        hier_local = (self.backend.local_size()
+                      if (self.config.hierarchical_allreduce and
+                          self._hierarchical_ok()) else 0)
         results: Dict[int, jax.Array] = {}
         for idxs in buckets:
-            packed, treedef = C.pack([tensors[i] for i in idxs])
-            out = fn(self.backend.to_global(packed))
-            # one global array per bucket; defer unpack to extraction
+            bucket = [tensors[i] for i in idxs]
+            shapes = tuple(tuple(t.shape) for t in bucket)
+            dtype = bucket[0].dtype
+            # Two dispatches per bucket: jitted pack, then the fused
+            # reduce+unpack program — one collective launch, no per-tensor
+            # host round-trips (fusion buffer role,
+            # collective_operations.cc:38-82).
+            pack_fn = self._builder(("pack", shapes, str(dtype)),
+                                    lambda: C.build_pack(shapes, dtype))
+            packed = pack_fn(*bucket)
+            fn = self._builder(
+                ("fused_allreduce", op, prescale_factor, postscale_factor,
+                 shapes, str(dtype), hier_local),
+                lambda: C.build_fused_allreduce(
+                    mesh, self._axis(), op, shapes, dtype,
+                    prescale_factor, postscale_factor, hier_local))
+            outs = fn(self.backend.to_global(packed))
+            group = LaunchGroup(outs[-1])
             for pos, i in enumerate(idxs):
-                results[i] = (out, treedef, pos)
+                results[i] = (outs[pos], group)
         handles = []
         for i, nm in enumerate(names):
-            garr, treedef, pos = results[i]
-
-            def extract(gs, treedef=treedef, pos=pos):
-                local = self.backend.from_global(gs[0])
-                return C.unpack(local, treedef)[pos]
-
-            h = Handle(nm, [garr], extract, self)
+            garr, group = results[i]
+            h = Handle(nm, [garr],
+                       lambda gs: self.backend.from_replicated(gs[0]), self,
+                       group=group)
             self._track(nm, h)
             handles.append(h)
         return handles
@@ -313,7 +373,7 @@ class Engine:
         out = fn(self.backend.to_global(xp))
 
         def extract(gs):
-            local = self.backend.from_global(gs[0])  # (size*max_d0, *s)
+            local = self.backend.from_replicated(gs[0])  # (size*max_d0, *s)
             if all(int(s) == max_d0 for s in sizes):
                 return local
             parts = [local[r * max_d0: r * max_d0 + int(sizes[r])]
@@ -393,7 +453,7 @@ class Engine:
         fn = self._builder(("reducescatter", op),
                            lambda: C.build_reducescatter(mesh, self._axis(), op))
         out = fn(self.backend.to_global(x))
-        return self._single(name, out)
+        return self._single(name, out, replicated=False)
 
     def barrier(self):
         mesh = self.backend.group_mesh
@@ -412,7 +472,7 @@ class Engine:
         mesh = self.backend.group_mesh
         fn = self._builder(("allgather",), lambda: C.build_allgather(mesh, self._axis()))
         garr = fn(self.backend.to_global(jnp.asarray(local_vec)))
-        local = self.backend.from_global(garr)
+        local = self.backend.from_replicated(garr)
         return np.asarray(local).reshape(self.backend.size(), *local_vec.shape)
 
 

@@ -55,12 +55,17 @@ def build(out_path: Optional[str] = None, quiet: bool = True) -> str:
     newest_src = max(os.path.getmtime(s) for s in srcs)
     if os.path.exists(out_path) and os.path.getmtime(out_path) >= newest_src:
         return out_path
+    # Compile to a per-process temp file and rename: concurrent builders
+    # (N launched workers on a fresh checkout) each publish atomically
+    # instead of interleaving writes into one corrupt .so.
+    tmp_path = f"{out_path}.{os.getpid()}.tmp"
     cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
-           "-o", out_path] + srcs
+           "-o", tmp_path] + srcs
     res = subprocess.run(cmd, capture_output=True, text=True)
     if res.returncode != 0:
         raise RuntimeError(
             f"native build failed ({' '.join(cmd)}):\n{res.stderr}")
+    os.replace(tmp_path, out_path)
     if not quiet:
         _LOG.info("built %s", out_path)
     return out_path

@@ -66,16 +66,20 @@ def build_adasum(mesh: Mesh, axis: str, prescale_factor: float = 1.0,
     """
     n = mesh.shape[axis]
 
-    def body(x):
+    def body(x):  # (1, *s) block in, replicated out (see build_allreduce)
         v = x[0]
         if prescale_factor != 1.0:
             v = v * prescale_factor
         v = adasum_p(v, axis, n)
         if postscale_factor != 1.0:
             v = v * postscale_factor
-        return v[None]
+        return v
 
-    fn = shard_map(body, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+    # check_vma=False: the VHDD recursion is rank-symmetric, so every rank
+    # ends with the identical combined vector — replicated by construction,
+    # but not statically inferrable through ppermute.
+    fn = shard_map(body, mesh=mesh, in_specs=P(axis), out_specs=P(),
+                   check_vma=False)
     return jax.jit(fn)
 
 

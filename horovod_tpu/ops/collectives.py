@@ -22,6 +22,8 @@ All builders are shape-polymorphic only through the jit cache: each distinct
 
 from __future__ import annotations
 
+import math
+
 from typing import Sequence
 
 import jax
@@ -162,22 +164,28 @@ def reducescatter_p(x, axis_name: str, op: ReduceOp = ReduceOp.SUM):
 # ---------------------------------------------------------------------------
 
 
-def _shmap(fn, mesh: Mesh, axis: str, in_specs, out_specs):
-    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+def _shmap(fn, mesh: Mesh, axis: str, in_specs, out_specs, check_vma=True):
+    # check_vma=False is needed where the output IS replicated by
+    # construction (e.g. a ppermute-pair recursion or a grouped
+    # reduce-scatter/all-gather ladder that ends with every rank holding the
+    # same value) but shard_map's varying-manual-axes checker cannot infer it.
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_vma=check_vma)
 
 
 def build_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
                     prescale_factor: float = 1.0, postscale_factor: float = 1.0):
-    """Stacked allreduce: (n, *s) -> (n, *s) with every slice = reduced value.
+    """Stacked-in, replicated-out allreduce: (n, *s) -> (*s).
 
-    The output stays sharded across the group so each rank reads back only its
-    addressable shard — no host gather.
+    The output is replicated (out_specs=P()) — every rank's addressable shard
+    IS the reduced tensor, so extraction is a zero-dispatch shard read (no
+    eager slice per tensor, which costs a device round-trip on tunneled
+    backends).
     """
     def body(x):  # x block: (1, *s)
-        v = allreduce_p(x[0], axis, op, prescale_factor, postscale_factor)
-        return v[None]
+        return allreduce_p(x[0], axis, op, prescale_factor, postscale_factor)
 
-    fn = _shmap(body, mesh, axis, in_specs=P(axis), out_specs=P(axis))
+    fn = _shmap(body, mesh, axis, in_specs=P(axis), out_specs=P())
     return jax.jit(fn)
 
 
@@ -202,11 +210,10 @@ def build_hierarchical_allreduce(mesh: Mesh, axis: str, local_size: int,
     cross_groups = [[c * local_size + l for c in range(cross)]
                     for l in range(local_size)]
 
-    def body(x):  # x block: (1, *s)
+    def body(x):  # x block: (1, *s); output replicated (see build_allreduce)
         v = x[0]
         if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
-            out = allreduce_p(v, axis, op, prescale_factor, postscale_factor)
-            return out[None]
+            return allreduce_p(v, axis, op, prescale_factor, postscale_factor)
         if prescale_factor != 1.0:
             v = v * prescale_factor
         orig_shape = v.shape
@@ -233,27 +240,31 @@ def build_hierarchical_allreduce(mesh: Mesh, axis: str, local_size: int,
             out = out / n
         if postscale_factor != 1.0:
             out = out * postscale_factor
-        return out[None]
+        return out
 
-    fn = _shmap(body, mesh, axis, in_specs=P(axis), out_specs=P(axis))
+    fn = _shmap(body, mesh, axis, in_specs=P(axis), out_specs=P(),
+                check_vma=False)
     return jax.jit(fn)
 
 
 def build_allgather(mesh: Mesh, axis: str):
-    """Stacked allgather of equal-shape tensors: (n, d0, *s) -> (n, n*d0, *s)
-    (every rank ends with the concatenation along dim 0)."""
+    """Stacked-in, replicated-out allgather of equal-shape tensors:
+    (n, d0, *s) -> (n*d0, *s) (every rank ends with the concatenation along
+    dim 0 — identical everywhere, hence replicated output)."""
     def body(x):  # (1, d0, *s)
-        return allgather_p(x[0], axis)[None]
+        return allgather_p(x[0], axis)
 
-    fn = _shmap(body, mesh, axis, in_specs=P(axis), out_specs=P(axis))
+    # all_gather output is identical on every rank but not VMA-inferrable
+    fn = _shmap(body, mesh, axis, in_specs=P(axis), out_specs=P(),
+                check_vma=False)
     return jax.jit(fn)
 
 
 def build_broadcast(mesh: Mesh, axis: str, root_rank: int):
     def body(x):
-        return broadcast_p(x[0], axis, root_rank)[None]
+        return broadcast_p(x[0], axis, root_rank)
 
-    fn = _shmap(body, mesh, axis, in_specs=P(axis), out_specs=P(axis))
+    fn = _shmap(body, mesh, axis, in_specs=P(axis), out_specs=P())
     return jax.jit(fn)
 
 
@@ -275,40 +286,86 @@ def build_reducescatter(mesh: Mesh, axis: str, op: ReduceOp = ReduceOp.SUM):
     return jax.jit(fn)
 
 
+def build_fused_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
+                          shapes, dtype,
+                          prescale_factor: float = 1.0,
+                          postscale_factor: float = 1.0,
+                          local_size: int = 0):
+    """One-launch fused bucket allreduce: takes the stacked *packed* buffer
+    (n, total) and returns one stacked (n, *shape_i) array per bucket member,
+    reduced — pack→collective→unpack in a single jitted program (the whole
+    point of the reference's fusion buffer, collective_operations.cc:38-82:
+    one launch and no per-tensor host round-trips).
+
+    ``local_size > 0`` selects the hierarchical ladder (reference
+    NCCLHierarchicalAllreduce nccl_operations.cc:180-383) on the packed
+    buffer; 0 = flat psum.
+    """
+    n = int(mesh.devices.size)
+    sizes = [math.prod(s) for s in shapes]
+
+    if local_size > 1:
+        assert n % local_size == 0, (n, local_size)
+        cross = n // local_size
+        local_groups = [[c * local_size + l for l in range(local_size)]
+                        for c in range(cross)]
+        cross_groups = [[c * local_size + l for c in range(cross)]
+                        for l in range(local_size)]
+
+    def _reduce_flat(flat):
+        if op not in (ReduceOp.SUM, ReduceOp.AVERAGE) or local_size <= 1:
+            return allreduce_p(flat, axis, op, 1.0, 1.0)
+        pad = (-flat.shape[0]) % n
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+        shard = lax.psum_scatter(flat, axis, scatter_dimension=0, tiled=True,
+                                 axis_index_groups=local_groups)
+        shard = lax.psum_scatter(shard, axis, scatter_dimension=0, tiled=True,
+                                 axis_index_groups=cross_groups)
+        out = lax.all_gather(shard, axis, axis=0, tiled=True,
+                             axis_index_groups=cross_groups)
+        out = lax.all_gather(out, axis, axis=0, tiled=True,
+                             axis_index_groups=local_groups)
+        if pad:
+            out = out[:-pad]
+        if op == ReduceOp.AVERAGE:
+            out = out / n
+        return out
+
+    def body(x):  # x block: (1, total)
+        flat = x[0]
+        if prescale_factor != 1.0:
+            flat = flat * prescale_factor
+        out = _reduce_flat(flat)
+        if postscale_factor != 1.0:
+            out = out * postscale_factor
+        pieces = []
+        offset = 0
+        for shape, size in zip(shapes, sizes):
+            pieces.append(
+                lax.dynamic_slice_in_dim(out, offset, size).reshape(shape))
+            offset += size
+        return tuple(pieces)
+
+    fn = _shmap(body, mesh, axis, in_specs=P(axis),
+                out_specs=tuple(P() for _ in shapes),
+                check_vma=(local_size <= 1))
+    return jax.jit(fn)
+
+
+def build_pack(shapes, dtype):
+    """Jitted pack: N local tensors -> one flat buffer (single dispatch)."""
+    def f(*ts):
+        return jnp.concatenate([jnp.ravel(t) for t in ts]) if ts \
+            else jnp.zeros((0,), dtype)
+    return jax.jit(f)
+
+
 def build_barrier(mesh: Mesh, axis: str):
     """Barrier = tiny psum every rank must join (reference:
     MPIController::Barrier mpi_controller.cc:225)."""
     def body(x):
-        return lax.psum(x[0], axis)[None]
+        return lax.psum(x[0], axis)
 
-    fn = _shmap(body, mesh, axis, in_specs=P(axis), out_specs=P(axis))
+    fn = _shmap(body, mesh, axis, in_specs=P(axis), out_specs=P())
     return jax.jit(fn)
-
-
-# ---------------------------------------------------------------------------
-# Fusion helpers: flatten a list of tensors into one 1-D buffer and back.
-# TPU-native replacement for the fusion buffer memcpy in/out
-# (collective_operations.cc:38-82, controller.cc:652-773 FuseResponses) — under
-# jit the concat/split fuse into the collective, giving one launch per bucket.
-# ---------------------------------------------------------------------------
-
-
-def pack(tensors: Sequence[jax.Array]):
-    """Concatenate flattened tensors; returns (buffer, treedef) where treedef is
-    the (shapes, dtypes, sizes) needed by :func:`unpack`."""
-    shapes = [t.shape for t in tensors]
-    dtypes = [t.dtype for t in tensors]
-    sizes = [int(jnp.size(t)) if not hasattr(t, "size") else int(t.size) for t in tensors]
-    buf = jnp.concatenate([jnp.ravel(t) for t in tensors]) if tensors else jnp.zeros((0,))
-    return buf, (shapes, dtypes, sizes)
-
-
-def unpack(buffer: jax.Array, treedef):
-    shapes, dtypes, sizes = treedef
-    out = []
-    offset = 0
-    for shape, dtype, size in zip(shapes, dtypes, sizes):
-        out.append(lax.dynamic_slice_in_dim(buffer, offset, size).reshape(shape)
-                   .astype(dtype))
-        offset += size
-    return out

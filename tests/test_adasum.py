@@ -37,10 +37,9 @@ def test_adasum_vhdd_matches_reference(mesh8, shape):
     rng = np.random.RandomState(42)
     data = rng.randn(n, *shape).astype(np.float32)
     fn = build_adasum(mesh8, WORLD_AXIS)
-    out = np.asarray(fn(stacked(mesh8, data)))
+    out = np.asarray(fn(stacked(mesh8, data)))  # replicated: (*shape)
     expected = adasum_reference([data[r] for r in range(n)]).reshape(shape)
-    for r in range(n):
-        np.testing.assert_allclose(out[r], expected, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
 
 
 def test_adasum_requires_power_of_2(mesh8):

@@ -33,11 +33,10 @@ def test_allreduce_sum(mesh8, dtype, shape):
     rng = np.random.RandomState(0)
     data = (rng.randint(-10, 10, size=(n,) + shape)).astype(dtype)
     fn = C.build_allreduce(mesh8, WORLD_AXIS, ReduceOp.SUM)
-    out = np.asarray(fn(stacked(mesh8, data))).astype(np.float64)
+    out = np.asarray(fn(stacked(mesh8, data))).astype(np.float64)  # replicated
     expected = data.astype(np.float64).sum(axis=0)
-    for r in range(n):
-        np.testing.assert_allclose(out[r], expected,
-                                   rtol=2e-2 if dtype == ml_dtypes.bfloat16 else 1e-5)
+    np.testing.assert_allclose(out, expected,
+                               rtol=2e-2 if dtype == ml_dtypes.bfloat16 else 1e-5)
 
 
 @pytest.mark.parametrize("op,npfn", [
@@ -49,7 +48,7 @@ def test_allreduce_minmaxprod(mesh8, op, npfn):
     fn = C.build_allreduce(mesh8, WORLD_AXIS, op)
     out = np.asarray(fn(stacked(mesh8, data)))
     expected = npfn(data, axis=0)
-    np.testing.assert_allclose(out[0], expected, rtol=1e-4)
+    np.testing.assert_allclose(out, expected, rtol=1e-4)
 
 
 def test_allreduce_average_and_scales(mesh8):
@@ -57,22 +56,21 @@ def test_allreduce_average_and_scales(mesh8):
     data = np.arange(n * 6, dtype=np.float32).reshape(n, 6)
     fn = C.build_allreduce(mesh8, WORLD_AXIS, ReduceOp.AVERAGE)
     out = np.asarray(fn(stacked(mesh8, data)))
-    np.testing.assert_allclose(out[3], data.mean(axis=0), rtol=1e-6)
+    np.testing.assert_allclose(out, data.mean(axis=0), rtol=1e-6)
 
     fn2 = C.build_allreduce(mesh8, WORLD_AXIS, ReduceOp.SUM,
                             prescale_factor=0.5, postscale_factor=2.0)
     out2 = np.asarray(fn2(stacked(mesh8, data)))
-    np.testing.assert_allclose(out2[0], data.sum(axis=0), rtol=1e-6)
+    np.testing.assert_allclose(out2, data.sum(axis=0), rtol=1e-6)
 
 
 def test_allgather(mesh8):
     n = 8
     data = np.random.RandomState(2).randn(n, 3, 4).astype(np.float32)
     fn = C.build_allgather(mesh8, WORLD_AXIS)
-    out = np.asarray(fn(stacked(mesh8, data)))  # (n, n*3, 4)
+    out = np.asarray(fn(stacked(mesh8, data)))  # replicated: (n*3, 4)
     expected = data.reshape(n * 3, 4)
-    for r in range(n):
-        np.testing.assert_array_equal(out[r], expected)
+    np.testing.assert_array_equal(out, expected)
 
 
 @pytest.mark.parametrize("root", [0, 3, 7])
@@ -80,9 +78,8 @@ def test_broadcast(mesh8, root):
     n = 8
     data = np.stack([np.full((5,), r, dtype=np.float32) for r in range(n)])
     fn = C.build_broadcast(mesh8, WORLD_AXIS, root)
-    out = np.asarray(fn(stacked(mesh8, data)))
-    for r in range(n):
-        np.testing.assert_array_equal(out[r], np.full((5,), root, np.float32))
+    out = np.asarray(fn(stacked(mesh8, data)))  # replicated: (5,)
+    np.testing.assert_array_equal(out, np.full((5,), root, np.float32))
 
 
 def test_alltoall_equal(mesh8):
@@ -114,17 +111,6 @@ def test_barrier(mesh8):
     out = fn(jax.device_put(jnp.zeros((8,), jnp.int32),
                             NamedSharding(mesh8, P(WORLD_AXIS))))
     out.block_until_ready()
-
-
-def test_pack_unpack_roundtrip():
-    ts = [jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
-          jnp.ones((5,), jnp.float32) * 2,
-          jnp.zeros((1, 1, 4), jnp.float32)]
-    buf, td = C.pack(ts)
-    assert buf.shape == (6 + 5 + 4,)
-    out = C.unpack(buf, td)
-    for a, b in zip(ts, out):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_bucketing():

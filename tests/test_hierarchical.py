@@ -31,7 +31,7 @@ class TestHierarchicalBuilder:
         hier = C.build_hierarchical_allreduce(mesh8, "world", local_size,
                                               ReduceOp.SUM)
         out = np.asarray(hier(garr))
-        expected = x.sum(axis=0, keepdims=True).repeat(8, axis=0)
+        expected = x.sum(axis=0)
         np.testing.assert_allclose(out, expected, rtol=1e-5)
 
     def test_matches_flat_average(self, mesh8):
@@ -40,7 +40,7 @@ class TestHierarchicalBuilder:
                                               ReduceOp.AVERAGE)
         out = np.asarray(hier(garr))
         np.testing.assert_allclose(
-            out, x.mean(axis=0, keepdims=True).repeat(8, axis=0), rtol=1e-5)
+            out, x.mean(axis=0), rtol=1e-5)
 
     def test_min_fallback(self, mesh8):
         x, garr = _stacked(mesh8, (6,), seed=2)
@@ -48,7 +48,7 @@ class TestHierarchicalBuilder:
                                               ReduceOp.MIN)
         out = np.asarray(hier(garr))
         np.testing.assert_allclose(
-            out, x.min(axis=0, keepdims=True).repeat(8, axis=0), rtol=1e-6)
+            out, x.min(axis=0), rtol=1e-6)
 
     def test_prescale_postscale(self, mesh8):
         x, garr = _stacked(mesh8, (8,), seed=3)
@@ -57,8 +57,7 @@ class TestHierarchicalBuilder:
                                               prescale_factor=0.5,
                                               postscale_factor=2.0)
         out = np.asarray(hier(garr))
-        np.testing.assert_allclose(
-            out, x.sum(axis=0, keepdims=True).repeat(8, axis=0), rtol=1e-5)
+        np.testing.assert_allclose(out, x.sum(axis=0), rtol=1e-5)
 
 
 class TestHierarchicalPrimitive:
