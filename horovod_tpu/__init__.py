@@ -226,6 +226,16 @@ def barrier():
     _engine().barrier()
 
 
+def step_heartbeat(step: Optional[int] = None):
+    """SPMD-path liveness signal for the stall inspector: call once per
+    (jitted) train step. When a rendezvous KV is present, rank 0 attributes
+    hangs to the rank whose heartbeat stopped advancing
+    (stall_inspector.h:70-92 cross-rank attribution)."""
+    st = global_state()
+    if st.stall_inspector is not None:
+        st.stall_inspector.record_heartbeat(step)
+
+
 def poll(handle) -> bool:
     return handle.poll()
 
@@ -264,7 +274,7 @@ __all__ = [
     "allreduce", "allreduce_async", "grouped_allreduce", "grouped_allreduce_async",
     "allgather", "allgather_async", "broadcast", "broadcast_async",
     "alltoall", "alltoall_async", "reducescatter", "reducescatter_async",
-    "barrier", "join", "poll", "synchronize",
+    "barrier", "join", "poll", "synchronize", "step_heartbeat",
     "broadcast_parameters", "broadcast_object", "allgather_object",
     "broadcast_optimizer_state",
     "DistributedOptimizer", "Compression", "optimizer", "elastic",

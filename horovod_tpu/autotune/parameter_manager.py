@@ -58,6 +58,7 @@ class ParameterManager:
         self._scores: List[float] = []
         self._step_bytes = 0
         self._step_start: Optional[float] = None
+        self._step_count = 0
         self._log_path = log_path
         self._log_file = open(log_path, "w") if log_path else None
         if self._log_file:
@@ -95,13 +96,20 @@ class ParameterManager:
             return
         now = time.perf_counter()
         if self._step_start is not None and self._step_bytes > 0:
-            elapsed = now - self._step_start
-            if elapsed > 0:
-                self._scores.append(self._step_bytes / elapsed)
-                if len(self._scores) >= self._steps_per_sample:
-                    score = float(np.mean(self._scores))
-                    self._scores = []
-                    self._on_sample(score)
+            # clamp, don't skip: sample boundaries below must stay in lockstep
+            # across ranks, so a zero-resolution clock interval on one rank
+            # must not desynchronize its score count (ADVICE r1-low).
+            elapsed = max(now - self._step_start, 1e-9)
+            self._scores.append(self._step_bytes / elapsed)
+        # Sample boundaries are driven by a deterministic per-call counter:
+        # every rank calls step_mark in the same program order, so _on_sample
+        # (which runs a *collective* parameter sync) fires at exactly the
+        # same call index everywhere.
+        self._step_count += 1
+        if self._step_count % self._steps_per_sample == 0:
+            score = float(np.mean(self._scores)) if self._scores else 0.0
+            self._scores = []
+            self._on_sample(score)
         self._step_start = time.perf_counter()
         self._step_bytes = nbytes
 

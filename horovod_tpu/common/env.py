@@ -31,8 +31,10 @@ HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
 HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
 HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
 HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
+# bounds the engine's builder cache (ResponseCache analog, engine._builder)
 HOROVOD_CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
-HOROVOD_BATCH_D2D_MEMCOPIES = "HOROVOD_BATCH_D2D_MEMCOPIES"
+# (HOROVOD_BATCH_D2D_MEMCOPIES has no TPU analog — XLA owns device memcpy
+# batching — and is intentionally not a knob here.)
 HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
 HOROVOD_RANK = "HOROVOD_RANK"
 HOROVOD_SIZE = "HOROVOD_SIZE"
@@ -51,6 +53,10 @@ HOROVOD_GLOO_IFACE = "HOROVOD_GLOO_IFACE"
 HOROVOD_TPU_COORDINATOR = "HOROVOD_TPU_COORDINATOR"          # host:port of jax coordinator
 HOROVOD_TPU_NUM_PROCESSES = "HOROVOD_TPU_NUM_PROCESSES"
 HOROVOD_TPU_PROCESS_ID = "HOROVOD_TPU_PROCESS_ID"
+# coordination-service failure detection (seconds); defaults are tighter in
+# elastic mode so peer crashes surface quickly (core/backend.py init())
+HOROVOD_TPU_HEARTBEAT_TIMEOUT = "HOROVOD_TPU_HEARTBEAT_TIMEOUT"
+HOROVOD_TPU_SHUTDOWN_TIMEOUT = "HOROVOD_TPU_SHUTDOWN_TIMEOUT"
 HOROVOD_TPU_DEBUG_CONSISTENCY = "HOROVOD_TPU_DEBUG_CONSISTENCY"
 HOROVOD_TPU_PLATFORM = "HOROVOD_TPU_PLATFORM"                 # cpu|tpu override (tests)
 

@@ -28,12 +28,20 @@ class WorkerRemovedError(RuntimeError):
     rendezvous plan)."""
 
 
-class TensorShapeMismatchError(ValueError):
+class ConsistencyError(ValueError):
+    """Cross-rank collective-submission disagreement detected by the
+    debug-mode consistency checker (HOROVOD_TPU_DEBUG_CONSISTENCY=1) — the
+    TPU-native analog of the coordinator's ConstructResponse validation
+    (controller.cc:380-623), which rejects mismatched name/op/shape/dtype
+    with the same descriptive error on every rank."""
+
+
+class TensorShapeMismatchError(ConsistencyError):
     """Cross-rank shape disagreement (reference surfaces these as ERROR
     responses built in controller.cc:380-623)."""
 
 
-class TensorDtypeMismatchError(ValueError):
+class TensorDtypeMismatchError(ConsistencyError):
     """Cross-rank dtype disagreement (controller.cc:380-623)."""
 
 

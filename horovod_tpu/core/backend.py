@@ -36,6 +36,7 @@ class Backend:
 
     def __init__(self):
         self._initialized = False
+        self._removed = False
         self._rank = 0
         self._size = 1
         self._local_rank = 0
@@ -52,13 +53,26 @@ class Backend:
     def init(self):
         if self._initialized:
             return
+        self._removed = False
         slot = None
-        if os.environ.get(env_mod.HOROVOD_ELASTIC):
+        elastic = bool(os.environ.get(env_mod.HOROVOD_ELASTIC))
+        if elastic:
             # Elastic worker: identity is (hostname, local_rank); the global
             # rank/size come from the rendezvous *every* init, so a reset
             # (shutdown+init) re-joins the new world — reference
             # gloo_context.cc:157-204 elastic re-init.
-            slot = self._fetch_elastic_slot()
+            from ..common.exceptions import WorkerRemovedError
+            try:
+                slot = self._fetch_elastic_slot()
+            except WorkerRemovedError:
+                # Scaled out before ever joining a world (removal racing the
+                # first init). Don't blow up user code that sits outside the
+                # @hvd.elastic.run wrapper: become an inert, removed, size-1
+                # backend; the run wrapper checks `removed` and exits the
+                # training loop cleanly.
+                self._removed = True
+                self._initialized = True
+                return
             os.environ[env_mod.HOROVOD_TPU_NUM_PROCESSES] = str(slot.size)
             os.environ[env_mod.HOROVOD_TPU_PROCESS_ID] = str(slot.rank)
             os.environ[env_mod.HOROVOD_RANK] = str(slot.rank)
@@ -70,10 +84,26 @@ class Backend:
             bind = None
             if coord == "@rendezvous":
                 coord, bind = self._resolve_coordinator(proc_id)
+            if elastic:
+                # A peer crash must surface as a catchable error on the
+                # survivors (reference: HorovodInternalError -> restore +
+                # re-init), not a process abort. Recoverable mode stops the
+                # coordination client from fatally terminating the process
+                # on peer failure and makes shutdown() non-blocking when
+                # peers are already gone.
+                jax.config.update("jax_enable_recoverability", True)
+            heartbeat = int(os.environ.get(
+                env_mod.HOROVOD_TPU_HEARTBEAT_TIMEOUT,
+                "10" if elastic else "100"))
+            shutdown_t = int(os.environ.get(
+                env_mod.HOROVOD_TPU_SHUTDOWN_TIMEOUT,
+                "30" if elastic else "300"))
             jax.distributed.initialize(coordinator_address=coord,
                                        num_processes=int(nprocs),
                                        process_id=proc_id,
-                                       coordinator_bind_address=bind)
+                                       coordinator_bind_address=bind,
+                                       heartbeat_timeout_seconds=heartbeat,
+                                       shutdown_timeout_seconds=shutdown_t)
             self._distributed = True
         self._rank = jax.process_index()
         self._size = jax.process_count()
@@ -162,6 +192,12 @@ class Backend:
         rdv_port = int(os.environ[env_mod.HOROVOD_GLOO_RENDEZVOUS_PORT])
         timeout = float(os.environ.get(env_mod.HOROVOD_GLOO_TIMEOUT_SECONDS,
                                        "120"))
+        # The key carries the world version: during cascaded failures the
+        # previous world's rank 0 may publish its (stale) address after the
+        # rendezvous cleared the scope for the new world — a versioned key
+        # can never satisfy a newer world's read.
+        version = os.environ.get("HOROVOD_TPU_WORLD_VERSION", "0")
+        key = f"addr.v{version}"
         if proc_id == 0:
             from ..runner.http_server import find_free_port
             port = find_free_port()
@@ -169,14 +205,14 @@ class Backend:
             if host in ("localhost", "::1"):
                 host = "127.0.0.1"
             addr = f"{host}:{port}"
-            put_data_into_kvstore(rdv_addr, rdv_port, "coordinator", "addr",
+            put_data_into_kvstore(rdv_addr, rdv_port, "coordinator", key,
                                   addr.encode(), timeout=timeout)
             # Keep the port reserved only between probe and bind — the same
             # (small) race the reference accepts; binding on 0.0.0.0 makes
             # the advertised hostname irrelevant locally.
             return addr, f"0.0.0.0:{port}"
         addr = read_data_from_kvstore(rdv_addr, rdv_port, "coordinator",
-                                      "addr", timeout=timeout).decode()
+                                      key, timeout=timeout).decode()
         return addr, None
 
     def shutdown(self):
@@ -213,6 +249,12 @@ class Backend:
     @property
     def initialized(self) -> bool:
         return self._initialized
+
+    @property
+    def removed(self) -> bool:
+        """True when this worker was scaled out of the elastic job at init
+        time and never joined the world (see init())."""
+        return self._removed
 
     # -- topology ----------------------------------------------------------
 

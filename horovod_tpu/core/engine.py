@@ -31,11 +31,42 @@ import jax
 import jax.numpy as jnp
 
 from ..common import env as env_mod
-from ..common.exceptions import DuplicateNameError
+from ..common.exceptions import DuplicateNameError, HorovodInternalError
 from ..common.reduce_ops import ReduceOp
 from ..ops import collectives as C
 from ..parallel.mesh import WORLD_AXIS
 from .backend import Backend
+
+
+def _translate_failure(fn, *args, **kwargs):
+    """Run a dispatch/completion call, converting runtime failures into
+    HorovodInternalError — the exception the elastic run-loop catches to
+    restore committed state and re-rendezvous (ADVICE r1-high; reference
+    behavior: framework ops wrap core failures in HorovodInternalError).
+
+    Only execution-boundary calls are wrapped (jitted collective dispatch,
+    block_until_ready/is_ready); argument validation raises before reaching
+    here, so a ValueError here is a collective failure (e.g. XLA's
+    "Gloo all-reduce failed ... Connection closed by peer" surfaces as
+    ValueError), not a user error."""
+    try:
+        return fn(*args, **kwargs)
+    except (DuplicateNameError, HorovodInternalError):
+        raise
+    except Exception as e:
+        raise HorovodInternalError(
+            f"collective execution failed (peer crashed or runtime error): "
+            f"{type(e).__name__}: {e}") from e
+
+
+def _check_average_dtype(x, op):
+    """User-argument validation must precede dispatch so it surfaces as a
+    plain ValueError, not a translated HorovodInternalError (parity with the
+    reference frontends' integer-average rejection)."""
+    if op == ReduceOp.AVERAGE and jnp.issubdtype(x.dtype, jnp.integer):
+        raise ValueError(
+            "Averaging is not supported for integer tensors; use op=Sum "
+            "(parity with the reference frontends' integer-average rejection)")
 
 
 class LaunchGroup:
@@ -57,9 +88,9 @@ class LaunchGroup:
     def ready(self) -> bool:
         if self._done:
             return True
-        try:
-            ok = self._rep.is_ready()
-        except AttributeError:  # older jax without is_ready
+        if hasattr(self._rep, "is_ready"):
+            ok = _translate_failure(self._rep.is_ready)
+        else:  # older jax without is_ready
             ok = True
         if ok:
             self._done = True
@@ -69,7 +100,7 @@ class LaunchGroup:
         if not self._done:
             with self._lock:
                 if not self._done:
-                    self._rep.block_until_ready()
+                    _translate_failure(self._rep.block_until_ready)
                     self._done = True
 
 
@@ -102,10 +133,9 @@ class Handle:
         if self._group is not None:
             ready = self._group.ready()
         else:
-            try:
-                ready = all(g.is_ready() for g in self._garrs)
-            except AttributeError:  # older jax without is_ready
-                ready = True
+            ready = all(_translate_failure(g.is_ready)
+                        for g in self._garrs
+                        if hasattr(g, "is_ready"))
         if ready:
             self._finish()
         return self._done
@@ -116,7 +146,7 @@ class Handle:
                 self._group.wait()
             else:
                 for g in self._garrs:
-                    g.block_until_ready()
+                    _translate_failure(g.block_until_ready)
             self._finish()
         return self._result
 
@@ -204,6 +234,12 @@ class Engine:
     def _builder(self, key: tuple, make: Callable):
         fn = self._builders.get(key)
         if fn is None:
+            # The builder cache is the ResponseCache analog
+            # (response_cache.h:45-102); HOROVOD_CACHE_CAPACITY bounds it the
+            # same way (FIFO eviction — steady-state jobs reuse a small,
+            # stable set of keys).
+            if len(self._builders) >= max(self.config.cache_capacity, 1):
+                self._builders.pop(next(iter(self._builders)))
             fn = make()
             self._builders[key] = fn
         return fn
@@ -232,6 +268,78 @@ class Engine:
     def _track(self, name: str, h: Handle):
         with self._lock:
             self._outstanding[name] = h
+
+    # -- debug-mode cross-rank consistency (controller.cc:380-623) ---------
+
+    @staticmethod
+    def _h63(s: str) -> int:
+        import hashlib
+        return int.from_bytes(hashlib.md5(s.encode()).digest()[:8],
+                              "little") >> 1
+
+    _META_DIMS = 6
+
+    def _debug_check(self, name: str, kind: str, tensors, op_code: int = -1,
+                     check_dim0: bool = True):
+        """When HOROVOD_TPU_DEBUG_CONSISTENCY=1, allgather a compact
+        (name-hash, kind, op, dtype, shape) fingerprint before dispatch and
+        raise the same descriptive error on every rank on any mismatch — the
+        debug-mode stand-in for the reference coordinator's submission
+        validation (controller.cc:380-623), which SPMD removes from the hot
+        path. ``check_dim0=False`` exempts dim 0 (allgather's legitimate
+        per-rank row counts, collective_operations.cc:88-195)."""
+        if not self.config.debug_consistency or self.backend.size() <= 1:
+            return
+        from ..common.exceptions import (ConsistencyError,
+                                         TensorDtypeMismatchError,
+                                         TensorShapeMismatchError)
+        rows = []
+        for t in tensors:
+            dims = [int(d) for d in t.shape[:self._META_DIMS]]
+            dims += [-1] * (self._META_DIMS - len(dims))
+            if not check_dim0 and t.ndim:
+                dims[0] = -2  # wildcard
+            rows.append([self._h63(name), self._h63(kind), op_code,
+                         self._h63(str(t.dtype)), t.ndim] + dims)
+        local = np.asarray(rows, dtype=np.int64).reshape(-1)
+        world = self._exchange_sizes(local)  # (size, k)
+        me = self.backend.rank()
+        for r in range(world.shape[0]):
+            if (world[r] == world[me]).all():
+                continue
+            a = world[me].reshape(len(tensors), -1)
+            b = world[r].reshape(len(tensors), -1)
+            for i in range(len(tensors)):
+                if (a[i] == b[i]).all():
+                    continue
+                loc = (f"rank {me}: name={name!r} kind={kind} op={op_code} "
+                       f"dtype={tensors[i].dtype} shape={tensors[i].shape}")
+                if a[i][0] != b[i][0] or a[i][1] != b[i][1]:
+                    raise ConsistencyError(
+                        f"Mismatched collective submissions: rank {r} "
+                        f"submitted a different tensor name or operation "
+                        f"type at this call index ({loc}); every rank must "
+                        f"submit the same named collectives in the same "
+                        f"order (controller.cc:380-623)")
+                if a[i][2] != b[i][2]:
+                    raise ConsistencyError(
+                        f"Mismatched reduce op for tensor {name!r}: rank {r} "
+                        f"used op code {int(b[i][2])}, this rank "
+                        f"{int(a[i][2])} ({loc})")
+                if a[i][3] != b[i][3]:
+                    raise TensorDtypeMismatchError(
+                        f"Mismatched dtype for tensor {name!r}: rank {r} "
+                        f"disagrees with this rank's {tensors[i].dtype} "
+                        f"({loc})")
+                raise TensorShapeMismatchError(
+                    f"Mismatched shape for tensor {name!r}: rank {r} sent "
+                    f"ndim={int(b[i][4])} dims="
+                    f"{[int(d) for d in b[i][5:] if d != -1]} vs this "
+                    f"rank's {tuple(tensors[i].shape)} ({loc})")
+            # rows differed but per-tensor comparison found no cause
+            raise ConsistencyError(
+                f"Mismatched collective submission metadata with rank {r} "
+                f"for {name!r} ({kind})")
 
     def _on_complete(self, h: Handle):
         with self._lock:
@@ -293,9 +401,11 @@ class Engine:
                   prescale_factor: float = 1.0,
                   postscale_factor: float = 1.0) -> Handle:
         x = jnp.asarray(tensor)
+        _check_average_dtype(x, op)
         name = self._register(name, "allreduce", x.nbytes)
+        self._debug_check(name, "allreduce", [x], op_code=int(op))
         fn = self._allreduce_builder(op, prescale_factor, postscale_factor)
-        out = fn(self.backend.to_global(x))
+        out = _translate_failure(lambda: fn(self.backend.to_global(x)))
         return self._single(name, out)
 
     def grouped_allreduce(self, tensors: Sequence, name: Optional[str] = None,
@@ -306,6 +416,8 @@ class Engine:
         <= fusion_threshold bucket per dtype), mirroring FuseResponses
         (controller.cc:652-773)."""
         tensors = [jnp.asarray(t) for t in tensors]
+        for t in tensors:
+            _check_average_dtype(t, op)
         pm = self.parameter_manager
         if pm is not None and pm.active:
             # program-ordered autotune step boundary: score the previous
@@ -317,6 +429,8 @@ class Engine:
         names = [self._register(None if name is None else f"{name}.{i}",
                                 "grouped_allreduce", t.nbytes)
                  for i, t in enumerate(tensors)]
+        self._debug_check(names[0] if names else "empty", "grouped_allreduce",
+                          tensors, op_code=int(op))
         buckets = bucket_by_size(tensors, self.config.fusion_threshold_bytes)
         mesh = self.backend.group_mesh
         hier_local = (self.backend.local_size()
@@ -333,14 +447,15 @@ class Engine:
             # collective_operations.cc:38-82).
             pack_fn = self._builder(("pack", shapes, str(dtype)),
                                     lambda: C.build_pack(shapes, dtype))
-            packed = pack_fn(*bucket)
+            packed = _translate_failure(pack_fn, *bucket)
             fn = self._builder(
                 ("fused_allreduce", op, prescale_factor, postscale_factor,
                  shapes, str(dtype), hier_local),
                 lambda: C.build_fused_allreduce(
                     mesh, self._axis(), op, shapes, dtype,
                     prescale_factor, postscale_factor, hier_local))
-            outs = fn(self.backend.to_global(packed))
+            outs = _translate_failure(
+                lambda: fn(self.backend.to_global(packed)))
             group = LaunchGroup(outs[-1])
             for pos, i in enumerate(idxs):
                 results[i] = (outs[pos], group)
@@ -360,6 +475,7 @@ class Engine:
         exchange first, then pad to max and gather, then slice+concat."""
         x = jnp.asarray(tensor)
         name = self._register(name, "allgather", x.nbytes)
+        self._debug_check(name, "allgather", [x], check_dim0=False)
         mesh = self.backend.group_mesh
         size = self.backend.size()
         d0 = int(x.shape[0]) if x.ndim else 1
@@ -369,8 +485,16 @@ class Engine:
             x = x[None]
         pad = max_d0 - d0
         xp = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1)) if pad else x
-        fn = self._builder(("allgather",), lambda: C.build_allgather(mesh, self._axis()))
-        out = fn(self.backend.to_global(xp))
+        if self.config.hierarchical_allgather and self._hierarchical_ok():
+            local = self.backend.local_size()
+            fn = self._builder(
+                ("hier_allgather", local),
+                lambda: C.build_hierarchical_allgather(mesh, self._axis(),
+                                                       local))
+        else:
+            fn = self._builder(("allgather",),
+                               lambda: C.build_allgather(mesh, self._axis()))
+        out = _translate_failure(lambda: fn(self.backend.to_global(xp)))
 
         def extract(gs):
             local = self.backend.from_replicated(gs[0])  # (size*max_d0, *s)
@@ -388,10 +512,11 @@ class Engine:
     def broadcast(self, tensor, root_rank: int, name: Optional[str] = None) -> Handle:
         x = jnp.asarray(tensor)
         name = self._register(name, "broadcast", x.nbytes)
+        self._debug_check(name, "broadcast", [x], op_code=root_rank)
         mesh = self.backend.group_mesh
         fn = self._builder(("broadcast", root_rank),
                            lambda: C.build_broadcast(mesh, self._axis(), root_rank))
-        out = fn(self.backend.to_global(x))
+        out = _translate_failure(lambda: fn(self.backend.to_global(x)))
         return self._single(name, out)
 
     def alltoall(self, tensor, splits=None, name: Optional[str] = None) -> Handle:
@@ -400,6 +525,7 @@ class Engine:
         result is (received_tensor, recv_splits)."""
         x = jnp.asarray(tensor)
         name = self._register(name, "alltoall", x.nbytes)
+        self._debug_check(name, "alltoall", [x], check_dim0=False)
         size = self.backend.size()
         mesh = self.backend.group_mesh
         if splits is None:
@@ -426,7 +552,7 @@ class Engine:
             jnp.pad(c, [(0, max_chunk - c.shape[0])] + [(0, 0)] * (x.ndim - 1))
             for c in chunks]) if size > 1 else x
         fn = self._builder(("alltoall",), lambda: C.build_alltoall(mesh, self._axis()))
-        out = fn(self.backend.to_global(padded))
+        out = _translate_failure(lambda: fn(self.backend.to_global(padded)))
 
         def extract(gs):
             local = self.backend.from_global(gs[0])  # (size*max_chunk, *s)
@@ -445,21 +571,24 @@ class Engine:
         if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
             raise ValueError(f"reducescatter supports Sum and Average, got {op!r}")
         x = jnp.asarray(tensor)
+        _check_average_dtype(x, op)
         name = self._register(name, "reducescatter", x.nbytes)
+        self._debug_check(name, "reducescatter", [x], op_code=int(op))
         size = self.backend.size()
         if int(x.shape[0]) % size != 0:
             raise ValueError("reducescatter requires dim0 divisible by size")
         mesh = self.backend.group_mesh
         fn = self._builder(("reducescatter", op),
                            lambda: C.build_reducescatter(mesh, self._axis(), op))
-        out = fn(self.backend.to_global(x))
+        out = _translate_failure(lambda: fn(self.backend.to_global(x)))
         return self._single(name, out, replicated=False)
 
     def barrier(self):
         mesh = self.backend.group_mesh
         fn = self._builder(("barrier",), lambda: C.build_barrier(mesh, self._axis()))
-        out = fn(self.backend.to_global(jnp.zeros((), jnp.int32)))
-        out.block_until_ready()
+        out = _translate_failure(
+            lambda: fn(self.backend.to_global(jnp.zeros((), jnp.int32))))
+        _translate_failure(out.block_until_ready)
 
     # -- helpers -----------------------------------------------------------
 
@@ -471,9 +600,11 @@ class Engine:
             return np.asarray(local_vec)[None]
         mesh = self.backend.group_mesh
         fn = self._builder(("allgather",), lambda: C.build_allgather(mesh, self._axis()))
-        garr = fn(self.backend.to_global(jnp.asarray(local_vec)))
+        garr = _translate_failure(
+            lambda: fn(self.backend.to_global(jnp.asarray(local_vec))))
         local = self.backend.from_replicated(garr)
-        return np.asarray(local).reshape(self.backend.size(), *local_vec.shape)
+        return _translate_failure(np.asarray, local).reshape(
+            self.backend.size(), *local_vec.shape)
 
 
 def bucket_by_size(tensors: Sequence[jax.Array], threshold_bytes: int) -> List[List[int]]:

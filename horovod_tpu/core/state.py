@@ -40,10 +40,17 @@ class GlobalState:
                                      mark_cycles=cfg.timeline_mark_cycles)
             self.timeline.start()
         if not cfg.stall_check_disable:
+            import os
             from ..stall_inspector import StallInspector
+            kv = None
+            rdv_addr = os.environ.get(env_mod.HOROVOD_GLOO_RENDEZVOUS_ADDR)
+            rdv_port = os.environ.get(env_mod.HOROVOD_GLOO_RENDEZVOUS_PORT)
+            if rdv_addr and rdv_port:
+                kv = (rdv_addr, int(rdv_port))
             self.stall_inspector = StallInspector(
                 warning_seconds=cfg.stall_warning_seconds,
-                shutdown_seconds=cfg.stall_shutdown_seconds)
+                shutdown_seconds=cfg.stall_shutdown_seconds,
+                kv=kv, rank=self.backend.rank(), size=self.backend.size())
 
         if cfg.autotune:
             from ..autotune.parameter_manager import ParameterManager

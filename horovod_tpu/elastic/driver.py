@@ -212,6 +212,7 @@ class ElasticDriver:
     # -- discovery thread ---------------------------------------------------
 
     def _discover_hosts(self):
+        last_notify = None  # (timestamp, update_res) of the pending change
         while not self._shutdown.is_set():
             try:
                 res = self._host_manager.update_available_hosts()
@@ -223,7 +224,16 @@ class ElasticDriver:
                 with self._lock:
                     self._pending_resume = True
                 self._registry.invalidate_ready()
-                self._notify_workers_host_changes(res)
+                last_notify = (int(time.time() * 1e6), res)
+                self._notify_workers_host_changes(*last_notify)
+            elif self.resume_needed() and last_notify is not None:
+                # Keep re-sending while the resume is pending: a worker that
+                # registered its notification address *after* the change was
+                # first pushed (slow startup) would otherwise never hear of
+                # it and the old world would run to completion under a
+                # pending resume. Same timestamp ⇒ already-notified
+                # listeners dedupe (state.py on_hosts_updated).
+                self._notify_workers_host_changes(*last_notify)
             self._shutdown.wait(DISCOVER_HOSTS_FREQUENCY_SECS)
 
     def _membership_matters(self, res: int) -> bool:
@@ -245,19 +255,30 @@ class ElasticDriver:
                     len(self._assignments)
         return False
 
-    def _notify_workers_host_changes(self, update_res: int):
+    def _notify_workers_host_changes(self, timestamp: int, update_res: int):
         """Push a hosts-updated event to every registered worker
         (reference driver.py:197-225); workers raise HostsUpdatedInterrupt at
         their next commit()."""
         from .worker import WorkerNotificationClient
-        timestamp = int(time.time() * 1e6)
-        for rank, addr in self._worker_addresses().items():
+
+        def _notify(rank, addr):
             try:
                 WorkerNotificationClient(addr).notify_hosts_updated(
                     timestamp, update_res)
             except Exception as e:
                 _LOG.debug("could not notify worker %s at %s: %s",
                            rank, addr, e)
+
+        # One thread per worker: an unreachable worker costs its own connect
+        # timeout, not 5s x N serialized inside the discovery loop
+        # (ADVICE r1-low).
+        threads = [threading.Thread(target=_notify, args=(rank, addr),
+                                    daemon=True)
+                   for rank, addr in self._worker_addresses().items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
 
     def _worker_addresses(self) -> Dict[str, str]:
         store = getattr(self._rendezvous, "worker_addresses", None)

@@ -93,7 +93,15 @@ class WorkerStateRegistry:
                     _LOG.info("worker %s -> %s", key, state)
             all_reported = bool(self._expected) and \
                 self._expected <= set(self._states)
-            candidate = all_reported and not self._barrier_fired
+            # A world whose every expected worker exited SUCCESS is a
+            # *finished* job, not a resumable one — resuming would relaunch
+            # fresh workers for already-completed ranks (observed flake:
+            # duplicate done-results after a pending membership change raced
+            # job completion).
+            all_success = bool(self._expected) and \
+                self._expected <= self._workers[SUCCESS]
+            candidate = all_reported and not all_success and \
+                not self._barrier_fired
         # Lock-order discipline: driver.resume_needed() takes driver._lock,
         # and _activate_workers (driver._lock held) calls our reset() — so
         # never query the driver while holding self._lock (AB-BA deadlock).

@@ -40,9 +40,25 @@ def run(func):
     return run_fn(func, _reset)
 
 
+def _is_removed() -> bool:
+    """Whether this worker was scaled out of the job at (re-)init time.
+
+    ``Backend.init`` absorbs a removal that races the *initial* ``hvd.init()``
+    (before any world was joined) into this flag instead of raising from
+    module-level user code (the un-catchable spot outside this wrapper)."""
+    from ..core.state import global_state
+    st = global_state()
+    return (st.backend is not None and st.backend.initialized and
+            st.backend.removed)
+
+
 def run_fn(func, reset):
     @functools.wraps(func)
     def wrapper(state, *args, **kwargs):
+        if _is_removed():
+            _LOG.info("worker was removed from the job before it joined a "
+                      "world; exiting cleanly")
+            return None
         notification_manager().init()
         notification_manager().register_listener(state)
         skip_sync = False
@@ -65,6 +81,9 @@ def run_fn(func, reset):
                     reset()
                 except WorkerRemovedError:
                     # this worker was scaled out of the job: a clean exit
+                    _LOG.info("worker removed from job; exiting")
+                    return None
+                if _is_removed():
                     _LOG.info("worker removed from job; exiting")
                     return None
                 # ranks shift with the new world: re-advertise the

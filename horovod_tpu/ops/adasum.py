@@ -56,8 +56,70 @@ def adasum_p(x, axis_name: str, axis_size: int):
     return x
 
 
+def adasum_combine_sharded(a, b, axis_name: str, groups):
+    """Pairwise Adasum where the logical vector is *sharded* across
+    ``groups`` along ``axis_name``: dot/|a|²/|b|² are computed on the local
+    shard and psum'd over the group so the coefficients correspond to the
+    full vector (the reference allreduces the 3-vector over the reduction
+    communicator, adasum.h:338-398)."""
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    triple = jnp.stack([jnp.sum(af * bf), jnp.sum(af * af),
+                        jnp.sum(bf * bf)])
+    dot, na, nb = lax.psum(triple, axis_name, axis_index_groups=groups)
+    ca = jnp.where(na == 0, 0.0, 1.0 - dot / (2.0 * jnp.where(na == 0, 1.0, na)))
+    cb = jnp.where(nb == 0, 0.0, 1.0 - dot / (2.0 * jnp.where(nb == 0, 1.0, nb)))
+    return (ca * af + cb * bf).astype(a.dtype)
+
+
+def hierarchical_adasum_p(x, axis_name: str, local_size: int, axis_size: int):
+    """Hierarchical Adasum over a 1-D axis factored as (cross, local).
+
+    TPU-native rebuild of AdasumGpuAllreduceOp (adasum_gpu_operations.cc:
+    157-255): reduce-scatter a *sum* within each local (node) group, run the
+    VHDD recursion across nodes on the scattered shards — with the
+    coefficient triples psum'd over the local group so they reflect the full
+    node vector (start_level=local_size in the reference's flat-rank
+    formulation, :249-255) — then all-gather the shards back locally. The
+    1/local_size prescale matches the frontend divisor logic for
+    hierarchical Adasum (torch/mpi_ops.py:79-103): the node's contribution
+    is the *mean* of its ranks' tensors.
+    """
+    cross = axis_size // local_size
+    if cross & (cross - 1):
+        raise ValueError(
+            f"hierarchical Adasum requires a power-of-2 cross size, got "
+            f"{cross} (= {axis_size}/{local_size})")
+    if local_size == 1:
+        return adasum_p(x, axis_name, axis_size)
+    local_groups = [[c * local_size + l for l in range(local_size)]
+                    for c in range(cross)]
+    orig_shape = x.shape
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    pad = (-n) % local_size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    flat = flat / local_size
+    shard = lax.psum_scatter(flat, axis_name, scatter_dimension=0, tiled=True,
+                             axis_index_groups=local_groups)
+    d = 1
+    while d < cross:
+        perm = [(c * local_size + l, (c ^ d) * local_size + l)
+                for c in range(cross) for l in range(local_size)]
+        other = lax.ppermute(shard, axis_name, perm)
+        shard = adasum_combine_sharded(shard, other, axis_name, local_groups)
+        d *= 2
+    out = lax.all_gather(shard, axis_name, axis=0, tiled=True,
+                         axis_index_groups=local_groups)
+    if pad:
+        out = out[:n]
+    return out.reshape(orig_shape)
+
+
 def build_adasum(mesh: Mesh, axis: str, prescale_factor: float = 1.0,
-                 postscale_factor: float = 1.0):
+                 postscale_factor: float = 1.0,
+                 local_size: int = 0):
     """Stacked Adasum builder for the eager engine: (n, *s) -> (n, *s).
 
     Pre/postscale factors match the reference Adasum path, where scaling (e.g.
@@ -70,7 +132,10 @@ def build_adasum(mesh: Mesh, axis: str, prescale_factor: float = 1.0,
         v = x[0]
         if prescale_factor != 1.0:
             v = v * prescale_factor
-        v = adasum_p(v, axis, n)
+        if local_size > 1:
+            v = hierarchical_adasum_p(v, axis, local_size, n)
+        else:
+            v = adasum_p(v, axis, n)
         if postscale_factor != 1.0:
             v = v * postscale_factor
         return v
@@ -88,11 +153,25 @@ def adasum_allreduce_handle(engine, tensor, name=None, prescale_factor=1.0,
     """Engine entry point for op=Adasum on the eager path."""
     x = jnp.asarray(tensor)
     name = engine._register(name, "adasum", x.nbytes)
+    engine._debug_check(name, "adasum", [x])
     mesh = engine.backend.group_mesh
-    fn = engine._builder(("adasum", prescale_factor, postscale_factor),
+    # Hierarchical variant (local mean -> cross VHDD -> local gather,
+    # adasum_gpu_operations.cc:157-255) when the topology supports it and
+    # HOROVOD_HIERARCHICAL_ALLREDUCE is on, like the reference's automatic
+    # NCCL-hierarchical Adasum on multi-GPU nodes.
+    local = 0
+    if engine.config.hierarchical_allreduce and engine._hierarchical_ok():
+        ls = engine.backend.local_size()
+        cross = engine.backend.size() // ls
+        if ls > 1 and cross >= 1 and (cross & (cross - 1)) == 0:
+            local = ls
+    fn = engine._builder(("adasum", prescale_factor, postscale_factor, local),
                          lambda: build_adasum(mesh, engine._axis(),
-                                              prescale_factor, postscale_factor))
-    out = fn(engine.backend.to_global(x))
+                                              prescale_factor,
+                                              postscale_factor,
+                                              local_size=local))
+    from ..core.engine import _translate_failure
+    out = _translate_failure(lambda: fn(engine.backend.to_global(x)))
     return engine._single(name, out)
 
 

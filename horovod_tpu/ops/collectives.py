@@ -247,6 +247,36 @@ def build_hierarchical_allreduce(mesh: Mesh, axis: str, local_size: int,
     return jax.jit(fn)
 
 
+def build_hierarchical_allgather(mesh: Mesh, axis: str, local_size: int):
+    """Two-level stacked allgather (HOROVOD_HIERARCHICAL_ALLGATHER; reference
+    MPIHierarchicalAllgather mpi_operations.cc:178: node-local gather through
+    a shared-memory window, then a cross-node exchange of whole node blocks).
+
+    TPU-native: gather along the fast local (ICI) sub-groups first, then
+    gather the resulting node blocks along the cross (DCN) sub-groups — the
+    slow links carry whole node blocks once instead of participating in the
+    full-world ring. Group ranges are contiguous, so block order equals rank
+    order and the result matches the flat allgather exactly.
+    """
+    n = int(mesh.devices.size)
+    assert n % local_size == 0, (n, local_size)
+    cross = n // local_size
+    local_groups = [[c * local_size + l for l in range(local_size)]
+                    for c in range(cross)]
+    cross_groups = [[c * local_size + l for c in range(cross)]
+                    for l in range(local_size)]
+
+    def body(x):  # (1, d0, *s)
+        local_block = lax.all_gather(x[0], axis, axis=0, tiled=True,
+                                     axis_index_groups=local_groups)
+        return lax.all_gather(local_block, axis, axis=0, tiled=True,
+                              axis_index_groups=cross_groups)
+
+    fn = _shmap(body, mesh, axis, in_specs=P(axis), out_specs=P(),
+                check_vma=False)
+    return jax.jit(fn)
+
+
 def build_allgather(mesh: Mesh, axis: str):
     """Stacked-in, replicated-out allgather of equal-shape tensors:
     (n, d0, *s) -> (n*d0, *s) (every rank ends with the concatenation along

@@ -1,33 +1,51 @@
 """Stall inspector (parity: horovod/common/stall_inspector.{h,cc}).
 
 The reference's coordinator warns when some ranks have submitted a tensor and
-others have not for >60s (stall_inspector.h:75) and can optionally shut the job
-down (stall_inspector.h:80). Under SPMD an un-matched collective manifests as a
-*hang* of an enqueued op, so our inspector watches the per-process outstanding
-set: any op enqueued but not completed for longer than the warning threshold is
-reported; past the shutdown threshold we raise in the watcher and abort.
+others have not for >60s (stall_inspector.h:75), lists *which* ranks are
+missing which tensors, and can optionally shut the job down
+(stall_inspector.h:80). Two layers here:
+
+- **Local watchdog**: any op enqueued but not completed past the warning
+  threshold is reported; past the shutdown threshold the process aborts.
+- **Cross-rank attribution** (when launched with a rendezvous KV): every rank
+  periodically publishes its outstanding set + a step heartbeat to the KV
+  (``stall/<rank>``); rank 0 aggregates and reports which ranks are missing
+  which tensors and which ranks stopped heartbeating — covering both the
+  eager path and (via :func:`record_heartbeat` around the jitted train step)
+  the SPMD hot path, where a hang is otherwise invisible to Python.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import threading
 import time
-from typing import Dict
+from typing import Dict, Optional, Tuple
 
 logger = logging.getLogger("horovod_tpu")
+
+KV_SCOPE = "stall"
 
 
 class StallInspector:
     def __init__(self, warning_seconds: float = 60.0, shutdown_seconds: float = 0.0,
-                 check_interval: float = 5.0):
+                 check_interval: float = 5.0,
+                 kv: Optional[Tuple[str, int]] = None,
+                 rank: int = 0, size: int = 1):
         self.warning_seconds = warning_seconds
         self.shutdown_seconds = shutdown_seconds
         self.check_interval = check_interval
+        self.kv = kv
+        self.rank = rank
+        self.size = size
         self._lock = threading.Lock()
         self._outstanding: Dict[str, float] = {}
         self._warned: set = set()
+        self._heartbeat_step = -1
+        self._heartbeat_time = time.time()
+        self._cross_warned: set = set()
         self._running = True
         self._thread = threading.Thread(target=self._watch, name="hvd-stall",
                                         daemon=True)
@@ -42,6 +60,15 @@ class StallInspector:
             self._outstanding.pop(name, None)
             self._warned.discard(name)
 
+    def record_heartbeat(self, step: Optional[int] = None):
+        """SPMD-path liveness signal: call around the jitted train step. A
+        rank whose heartbeat stops advancing while peers' do is reported by
+        rank 0's aggregation (stall_inspector.h:70-92 role)."""
+        with self._lock:
+            self._heartbeat_step = self._heartbeat_step + 1 if step is None \
+                else int(step)
+            self._heartbeat_time = time.time()
+
     def stalled_tensors(self):
         now = time.monotonic()
         with self._lock:
@@ -50,6 +77,87 @@ class StallInspector:
 
     def stop(self):
         self._running = False
+
+    # -- cross-rank attribution via the rendezvous KV -----------------------
+
+    def _publish(self):
+        from .runner.http_client import put_data_into_kvstore
+        now = time.monotonic()
+        with self._lock:
+            # Publish only tensors already stale locally: an op merely in
+            # flight on one rank while completed on another is normal
+            # asynchrony, not a stall — the reference likewise warns only
+            # past the warning threshold (stall_inspector.h:75).
+            stale = sorted(n for n, t in self._outstanding.items()
+                           if now - t > self.warning_seconds)
+            payload = {"ts": time.time(),
+                       "outstanding": stale,
+                       "hb_step": self._heartbeat_step,
+                       "hb_ts": self._heartbeat_time}
+        try:
+            put_data_into_kvstore(self.kv[0], self.kv[1], KV_SCOPE,
+                                  str(self.rank),
+                                  json.dumps(payload).encode(), timeout=5)
+        except Exception as e:
+            logger.debug("stall publish failed: %s", e)
+
+    def _aggregate(self):
+        """Rank 0: read every rank's report; attribute stalls to ranks
+        (reference: stall_inspector.cc builds 'missing ranks' per tensor)."""
+        from .runner.http_client import read_data_from_kvstore
+        reports: Dict[int, dict] = {}
+        for r in range(self.size):
+            try:
+                raw = read_data_from_kvstore(self.kv[0], self.kv[1], KV_SCOPE,
+                                             str(r), timeout=1,
+                                             poll_interval=0.1)
+                reports[r] = json.loads(raw)
+            except Exception:
+                continue
+        now = time.time()
+        # bound the dedup set: unique per-step tensor names would otherwise
+        # grow it for the life of the job
+        if len(self._cross_warned) > 4096:
+            self._cross_warned.clear()
+        # tensors stalled on some ranks but never submitted on others
+        all_outstanding: Dict[str, list] = {}
+        for r, rep in reports.items():
+            for name in rep.get("outstanding", ()):
+                all_outstanding.setdefault(name, []).append(r)
+        for name, have in sorted(all_outstanding.items()):
+            missing = [r for r in reports if r not in have]
+            key = ("tensor", name, tuple(missing))
+            if missing and key not in self._cross_warned:
+                self._cross_warned.add(key)
+                logger.warning(
+                    "Tensor %s was submitted by ranks %s but is missing on "
+                    "ranks %s — those ranks may have stopped contributing "
+                    "(stall_inspector.h:75 analog).", name, sorted(have),
+                    missing)
+        # stale heartbeats: a rank whose step stopped advancing
+        active = [r for r, rep in reports.items()
+                  if rep.get("hb_step", -1) >= 0]
+        if len(active) >= 2:
+            newest = max(reports[r]["hb_ts"] for r in active)
+            for r in active:
+                age = newest - reports[r]["hb_ts"]
+                key = ("hb", r, reports[r]["hb_step"])
+                if age > self.warning_seconds and key not in self._cross_warned:
+                    self._cross_warned.add(key)
+                    logger.warning(
+                        "Rank %d last advanced its train step (step %d) "
+                        "%.0f s before its peers — it may be hung inside the "
+                        "jitted step.", r, reports[r]["hb_step"], age)
+        # ranks that stopped publishing entirely
+        for r, rep in reports.items():
+            age = now - rep.get("ts", now)
+            key = ("silent", r)
+            if age > max(self.warning_seconds, 3 * self.check_interval) and \
+                    key not in self._cross_warned:
+                self._cross_warned.add(key)
+                logger.warning(
+                    "Rank %d has not reported liveness for %.0f s — process "
+                    "may be dead or wedged.", r, age)
 
     def _watch(self):
         while self._running:
@@ -71,3 +179,7 @@ class StallInspector:
                     logger.error("Stalled tensor %s exceeded shutdown threshold "
                                  "%.0f s; aborting.", name, self.shutdown_seconds)
                     os._exit(64)
+            if self.kv is not None and self.size > 1:
+                self._publish()
+                if self.rank == 0:
+                    self._aggregate()

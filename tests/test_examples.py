@@ -1,0 +1,54 @@
+"""Smoke tests for examples/ — run each example end-to-end (tiny settings) in
+a subprocess, CI-style (reference: examples are exercised by the buildkite
+pipeline, gen-pipeline.sh:163).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _run(args, timeout=420):
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    })
+    return subprocess.run([sys.executable] + args, env=env, timeout=timeout,
+                          capture_output=True, text=True)
+
+
+def test_mnist_mlp_example():
+    r = _run([os.path.join(EXAMPLES, "mnist_mlp.py"), "--epochs", "1",
+              "--batch-size", "512"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "loss=" in r.stdout, r.stdout
+
+
+def test_resnet_benchmark_example_spmd():
+    r = _run([os.path.join(EXAMPLES, "resnet50_synthetic_benchmark.py"),
+              "--batch-size", "2", "--num-iters", "2", "--num-warmup", "2"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "Total img/sec" in r.stdout, r.stdout
+
+
+def test_resnet_benchmark_example_eager():
+    r = _run([os.path.join(EXAMPLES, "resnet50_synthetic_benchmark.py"),
+              "--mode", "eager", "--batch-size", "2", "--num-iters", "2",
+              "--num-warmup", "2", "--fp16-allreduce"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "Img/sec per worker" in r.stdout, r.stdout
+
+
+def test_elastic_example_single_process():
+    r = _run([os.path.join(EXAMPLES, "elastic_synthetic.py"),
+              "--total-batches", "20", "--batch-size", "16"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "done:" in r.stdout, r.stdout

@@ -1,0 +1,75 @@
+"""Stall inspector tests (reference: test/test_stall.py + the coordinator's
+'which ranks are missing which tensors' attribution, stall_inspector.h:70-92).
+"""
+
+import logging
+import time
+
+import pytest
+
+from horovod_tpu.runner.http_server import KVStoreServer
+from horovod_tpu.stall_inspector import StallInspector
+
+
+@pytest.fixture
+def kv_server():
+    server = KVStoreServer(("127.0.0.1", 0))
+    server.start()
+    yield ("127.0.0.1", server.port)
+    server.stop()
+
+
+def test_local_stall_warning(caplog):
+    insp = StallInspector(warning_seconds=0.2, check_interval=0.1)
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        insp.record_enqueue("grad.7")
+        time.sleep(0.8)
+    insp.stop()
+    assert any("grad.7" in r.message for r in caplog.records)
+    assert insp.stalled_tensors()
+
+
+def test_done_clears_outstanding():
+    insp = StallInspector(warning_seconds=10, check_interval=0.1)
+    insp.record_enqueue("x")
+    insp.record_done("x")
+    assert insp.stalled_tensors() == []
+    insp.stop()
+
+
+def test_cross_rank_missing_tensor_attribution(kv_server, caplog):
+    """Rank 1 submits a tensor rank 0 never does: rank 0's aggregation names
+    both the tensor and the missing rank."""
+    addr, port = kv_server
+    r0 = StallInspector(warning_seconds=0.3, check_interval=0.15,
+                        kv=(addr, port), rank=0, size=2)
+    r1 = StallInspector(warning_seconds=0.3, check_interval=0.15,
+                        kv=(addr, port), rank=1, size=2)
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        r1.record_enqueue("grads.bad")
+        time.sleep(1.2)
+    r0.stop()
+    r1.stop()
+    msgs = [r.message for r in caplog.records]
+    assert any("grads.bad" in m and "missing on ranks [0]" in m
+               for m in msgs), msgs
+
+
+def test_cross_rank_heartbeat_attribution(kv_server, caplog):
+    """Rank 1's step heartbeat stops advancing while rank 0's continues:
+    rank 0 reports the hung rank (SPMD-path coverage)."""
+    addr, port = kv_server
+    r0 = StallInspector(warning_seconds=0.4, check_interval=0.15,
+                        kv=(addr, port), rank=0, size=2)
+    r1 = StallInspector(warning_seconds=0.4, check_interval=0.15,
+                        kv=(addr, port), rank=1, size=2)
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        r1.record_heartbeat(5)          # advances once, then goes silent
+        deadline = time.time() + 2.5
+        while time.time() < deadline:
+            r0.record_heartbeat()       # keeps advancing
+            time.sleep(0.1)
+    r0.stop()
+    r1.stop()
+    msgs = [r.message for r in caplog.records]
+    assert any("Rank 1" in m and "jitted step" in m for m in msgs), msgs
