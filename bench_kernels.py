@@ -1,0 +1,75 @@
+"""Kernel micro-benchmarks: Pallas vs lax for the Adasum combine and the
+fusion packer (VERDICT r1 #3). Prints one JSON line per comparison.
+
+Timing uses dependent chaining + host fetch (see bench.py: on the tunneled
+TPU backend block_until_ready returns early)."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _time(fn, args, iters=20):
+    import jax
+    out = fn(*args)
+    float(np.asarray(out).ravel()[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    float(np.asarray(out).ravel()[0])
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from horovod_tpu.ops.adasum import adasum_combine
+    from horovod_tpu.ops.pallas_kernels import (adasum_combine_pallas,
+                                                pack_pallas)
+    from horovod_tpu.ops.collectives import build_pack
+
+    rng = np.random.RandomState(0)
+    for n, dtype in [(1 << 20, jnp.float32), (1 << 24, jnp.float32),
+                     (1 << 24, jnp.bfloat16)]:
+        a = jnp.asarray(rng.randn(n), dtype)
+        b = jnp.asarray(rng.randn(n), dtype)
+        lax_fn = jax.jit(adasum_combine)
+        t_lax = _time(lax_fn, (a, b))
+        try:
+            t_pl = _time(adasum_combine_pallas, (a, b))
+        except Exception as e:
+            t_pl = None
+            err = f"{type(e).__name__}: {str(e)[:120]}"
+        print(json.dumps({
+            "bench": "adasum_combine", "n": n, "dtype": str(dtype.__name__),
+            "lax_ms": round(t_lax * 1e3, 3),
+            "pallas_ms": round(t_pl * 1e3, 3) if t_pl else None,
+            "winner": ("pallas" if t_pl and t_pl < t_lax else "lax"),
+            **({} if t_pl else {"pallas_error": err}),
+        }))
+
+    for count, size in [(100, 1024), (200, 1024), (160, 4096)]:
+        ts = [jnp.asarray(rng.randn(size), jnp.float32)
+              for _ in range(count)]
+        shapes = tuple(tuple(t.shape) for t in ts)
+        concat_fn = build_pack(shapes, jnp.float32)
+        t_concat = _time(concat_fn, ts)
+        try:
+            t_pl = _time(lambda *xs: pack_pallas(xs), ts)
+        except Exception as e:
+            t_pl = None
+            err = f"{type(e).__name__}: {str(e)[:120]}"
+        print(json.dumps({
+            "bench": "fusion_pack", "tensors": count, "each": size,
+            "concat_ms": round(t_concat * 1e3, 3),
+            "pallas_ms": round(t_pl * 1e3, 3) if t_pl else None,
+            "winner": ("pallas" if t_pl and t_pl < t_concat else "concat"),
+            **({} if t_pl else {"pallas_error": err}),
+        }))
+
+
+if __name__ == "__main__":
+    main()

@@ -245,16 +245,17 @@ def synchronize(handle):
 
 
 def join() -> int:
-    """Join op (parity: operations.cc EnqueueTensorJoin / torch join). Under the
-    fixed-shape SPMD contract a rank with no more data participates with zero
-    tensors; ``join()`` runs a final barrier-style consensus and returns the
-    last rank to join (reference returns the last joined rank)."""
-    eng = _engine()
-    import numpy as np
-    # allgather of a per-rank "join order" timestamp proxy: rank index — the
-    # consensus here is simply that everyone reached join().
-    eng.barrier()
-    return size() - 1
+    """Join op (parity: operations.cc:1004-1040 EnqueueTensorJoin / torch
+    join). A rank that is out of data calls ``join()`` and keeps matching the
+    other ranks' collectives with zero-tensor substitutes
+    (tensor_queue.h:39-41) until every rank has joined; returns the last rank
+    to join. Ranks may process different batch counts without hanging:
+
+        while have_data:
+            hvd.allreduce(grads, ...)
+        last = hvd.join()
+    """
+    return _engine().join()
 
 
 # Convenience re-exports

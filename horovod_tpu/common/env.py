@@ -36,6 +36,10 @@ HOROVOD_CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
 # (HOROVOD_BATCH_D2D_MEMCOPIES has no TPU analog — XLA owns device memcpy
 # batching — and is intentionally not a knob here.)
 HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
+# disables the per-op join round (ragged-batch Join support,
+# operations.cc:1004-1040); set =1 to shave the metadata exchange off the
+# eager hot path when no rank will ever run out of data early
+HOROVOD_JOIN_DISABLE = "HOROVOD_JOIN_DISABLE"
 HOROVOD_RANK = "HOROVOD_RANK"
 HOROVOD_SIZE = "HOROVOD_SIZE"
 HOROVOD_LOCAL_RANK = "HOROVOD_LOCAL_RANK"
@@ -115,6 +119,7 @@ class Config:
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
     debug_consistency: bool = False
+    join_enabled: bool = True
     elastic: bool = False
     extra: dict = field(default_factory=dict)
 
@@ -142,5 +147,6 @@ class Config:
             hierarchical_allreduce=_get_bool(HOROVOD_HIERARCHICAL_ALLREDUCE),
             hierarchical_allgather=_get_bool(HOROVOD_HIERARCHICAL_ALLGATHER),
             debug_consistency=_get_bool(HOROVOD_TPU_DEBUG_CONSISTENCY),
+            join_enabled=not _get_bool(HOROVOD_JOIN_DISABLE),
             elastic=_get_bool(HOROVOD_ELASTIC),
         )

@@ -185,6 +185,34 @@ class HandleManager:
             self._handles.pop(hid, None)
 
 
+# Join-protocol metadata encoding (operations.cc:1004-1040 EnqueueTensorJoin;
+# zero-tensor substitution tensor_queue.h:39-41). A joined rank learns each
+# pending op's (kind, op/root, dtype, shape) from these rows and dispatches a
+# matching zero-tensor launch until every rank has joined.
+_KIND_CODES = {"allreduce": 1, "grouped_allreduce": 2, "allgather": 3,
+               "broadcast": 4, "alltoall": 5, "reducescatter": 6,
+               "barrier": 7, "adasum": 8}
+_DTYPE_CODES = {"float32": 1, "float64": 2, "float16": 3, "bfloat16": 4,
+                "int8": 5, "int16": 6, "int32": 7, "int64": 8,
+                "uint8": 9, "uint16": 10, "uint32": 11, "uint64": 12,
+                "bool": 13}
+_CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
+_JOIN_META_DIMS = 7
+_JOIN_META_LEN = 3 + _JOIN_META_DIMS  # [op_or_root, dtype, ndim, d0..d6]
+
+
+def _join_meta_row(x, op_or_root: int) -> np.ndarray:
+    code = _DTYPE_CODES.get(str(x.dtype))
+    if code is None:
+        raise ValueError(f"dtype {x.dtype} unsupported under the Join "
+                         f"protocol; set HOROVOD_JOIN_DISABLE=1")
+    if x.ndim > _JOIN_META_DIMS:
+        raise ValueError(f"ndim {x.ndim} > {_JOIN_META_DIMS} unsupported "
+                         f"under the Join protocol")
+    dims = [int(d) for d in x.shape] + [-1] * (_JOIN_META_DIMS - x.ndim)
+    return np.array([op_or_root, code, x.ndim] + dims, dtype=np.int64)
+
+
 class Engine:
     def __init__(self, backend: Backend, config: env_mod.Config):
         self.backend = backend
@@ -202,6 +230,11 @@ class Engine:
         # fusion_threshold / cycle_time
         self.parameter_manager = None
         self._hier_ok: Optional[bool] = None
+        # One-shot flag: the next engine-method call is a Join zero-tensor
+        # substitute — it must skip its own join round (the join() loop
+        # already ran it) and send wildcard consistency rows (its auto name
+        # legitimately differs from the active ranks' tensor name).
+        self._join_substitute = False
         # Cycle loop: the analog of RunLoopOnce (operations.cc:566-616) — wakes
         # every cycle_time_ms to retire completed handles so fire-and-forget
         # async ops clear the outstanding table without user poll/synchronize.
@@ -269,6 +302,132 @@ class Engine:
         with self._lock:
             self._outstanding[name] = h
 
+    # -- Join protocol (operations.cc:1004-1040, tensor_queue.h:39-41) ------
+
+    def _consume_substitute(self) -> bool:
+        sub = self._join_substitute
+        self._join_substitute = False
+        return sub
+
+    def _join_sync(self, kind: str, metas, skip: bool = False,
+                   root_rank: Optional[int] = None):
+        """Per-op join round. Round A is one tiny fixed-size allgather; the
+        metadata round B runs only when round A shows a joined rank (the
+        common no-join case pays a single 4-int64 exchange). Active ranks
+        advertise the op they are about to run; ranks sitting in join() use
+        the same rounds to learn what zero-tensor substitute to dispatch.
+        ``skip=True`` on the substitute dispatch itself — its rounds already
+        ran inside the join() loop."""
+        if skip or not self.config.join_enabled or self.backend.size() <= 1:
+            return
+        k = len(metas)
+        head = np.array([0, 0, _KIND_CODES[kind], k], dtype=np.int64)
+        world = self._exchange_sizes(head)
+        any_joined = bool((world[:, 0] == 1).any())
+        if k and any_joined:
+            # round B must complete BEFORE any error below — the joined
+            # ranks are mid-exchange and would hang otherwise
+            self._exchange_sizes(np.concatenate(metas))
+        if root_rank is not None and world[root_rank, 0] == 1:
+            # A joined root has no data: substituting zeros would silently
+            # corrupt every receiver (the reference errors a joined
+            # broadcast root).
+            raise HorovodInternalError(
+                f"broadcast root rank {root_rank} has already joined and "
+                f"has no data to broadcast")
+
+    def join(self) -> int:
+        """This rank is out of data: keep matching peers' collectives with
+        zero tensors until every rank joins; returns the last joining rank
+        (reference join semantics, operations.cc:1004-1040)."""
+        size = self.backend.size()
+        if size <= 1:
+            return 0
+        if not self.config.join_enabled:
+            # legacy behavior: barrier-style consensus only
+            self.barrier()
+            return size - 1
+        rounds = 0
+        while True:
+            head = self._exchange_sizes(
+                np.array([1, rounds, 0, 0], dtype=np.int64))
+            joined = head[:, 0] == 1
+            if joined.all():
+                # everyone is in join(): the last joiner has the fewest
+                # rounds; ties break to the highest rank (deterministic —
+                # every rank sees the same matrix)
+                min_rounds = head[:, 1].min()
+                return int(max(r for r in range(size)
+                               if head[r, 1] == min_rounds))
+            act = int(np.argmin(joined))   # first still-active rank
+            kind_code = int(head[act, 2])
+            k = int(head[act, 3])
+            metas = None
+            if k:
+                flat = self._exchange_sizes(
+                    np.zeros((k * _JOIN_META_LEN,), dtype=np.int64))
+                metas = flat[act].reshape(k, _JOIN_META_LEN)
+            if kind_code == _KIND_CODES["broadcast"] and metas is not None \
+                    and int(metas[0][0]) == self.backend.rank():
+                # the active ranks raise on their side of this round too
+                raise HorovodInternalError(
+                    "this rank is the broadcast root but has already "
+                    "joined; it has no data to broadcast")
+            self._dispatch_substitute(kind_code, metas)
+            rounds += 1
+
+    def _dispatch_substitute(self, kind_code: int, metas):
+        """Dispatch a zero-tensor stand-in matching the active ranks' op
+        (tensor_queue.h:39-41 zero substitution). Runs the normal engine
+        method so every internal exchange/collective lines up with the
+        active ranks'."""
+        kind = {v: k for k, v in _KIND_CODES.items()}[kind_code]
+        if kind == "barrier":
+            self._join_substitute = True
+            self.barrier()
+            return
+
+        def zero(row):
+            dtype = _CODE_DTYPES[int(row[1])]
+            shape = tuple(int(d) for d in row[3:3 + int(row[2])])
+            return jnp.zeros(shape, dtype)
+
+        self._join_substitute = True
+        if kind == "grouped_allreduce":
+            op = ReduceOp(int(metas[0][0]))
+            hs = self.grouped_allreduce([zero(r) for r in metas], op=op)
+            for h in hs:
+                h.synchronize()
+        elif kind == "allreduce":
+            self.allreduce(zero(metas[0]),
+                           op=ReduceOp(int(metas[0][0]))).synchronize()
+        elif kind == "adasum":
+            from ..ops.adasum import adasum_allreduce_handle
+            adasum_allreduce_handle(self, zero(metas[0])).synchronize()
+        elif kind == "allgather":
+            self.allgather(zero(metas[0])).synchronize()
+        elif kind == "broadcast":
+            self.broadcast(zero(metas[0]),
+                           root_rank=int(metas[0][0])).synchronize()
+        elif kind == "reducescatter":
+            self.reducescatter(zero(metas[0]),
+                               op=ReduceOp(int(metas[0][0]))).synchronize()
+        elif kind == "alltoall":
+            z = zero(metas[0])
+            d0 = int(z.shape[0]) if z.ndim else 0
+            size = self.backend.size()
+            if d0 % size == 0:
+                splits = None
+            else:
+                # spread the zero rows evenly, mirroring the divisible path
+                base, rem = divmod(d0, size)
+                splits = np.array([base + (1 if i < rem else 0)
+                                   for i in range(size)], dtype=np.int32)
+            self.alltoall(z, splits=splits).synchronize()
+        else:
+            raise HorovodInternalError(
+                f"unknown substitute kind code {kind_code}")
+
     # -- debug-mode cross-rank consistency (controller.cc:380-623) ---------
 
     @staticmethod
@@ -280,7 +439,7 @@ class Engine:
     _META_DIMS = 6
 
     def _debug_check(self, name: str, kind: str, tensors, op_code: int = -1,
-                     check_dim0: bool = True):
+                     check_dim0: bool = True, wildcard: bool = False):
         """When HOROVOD_TPU_DEBUG_CONSISTENCY=1, allgather a compact
         (name-hash, kind, op, dtype, shape) fingerprint before dispatch and
         raise the same descriptive error on every rank on any mismatch — the
@@ -295,6 +454,13 @@ class Engine:
                                          TensorShapeMismatchError)
         rows = []
         for t in tensors:
+            if wildcard:
+                # Join zero-substitute: it must take part in the exchange
+                # (peers are mid-allgather) but its auto-generated name
+                # legitimately differs — sentinel rows are skipped by every
+                # rank's comparison.
+                rows.append([-9] * (5 + self._META_DIMS))
+                continue
             dims = [int(d) for d in t.shape[:self._META_DIMS]]
             dims += [-1] * (self._META_DIMS - len(dims))
             if not check_dim0 and t.ndim:
@@ -304,7 +470,11 @@ class Engine:
         local = np.asarray(rows, dtype=np.int64).reshape(-1)
         world = self._exchange_sizes(local)  # (size, k)
         me = self.backend.rank()
+        if wildcard:
+            return
         for r in range(world.shape[0]):
+            if world[r][0] == -9:  # a joined rank's sentinel
+                continue
             if (world[r] == world[me]).all():
                 continue
             a = world[me].reshape(len(tensors), -1)
@@ -401,9 +571,12 @@ class Engine:
                   prescale_factor: float = 1.0,
                   postscale_factor: float = 1.0) -> Handle:
         x = jnp.asarray(tensor)
+        sub = self._consume_substitute()
         _check_average_dtype(x, op)
         name = self._register(name, "allreduce", x.nbytes)
-        self._debug_check(name, "allreduce", [x], op_code=int(op))
+        self._join_sync("allreduce", [_join_meta_row(x, int(op))], skip=sub)
+        self._debug_check(name, "allreduce", [x], op_code=int(op),
+                          wildcard=sub)
         fn = self._allreduce_builder(op, prescale_factor, postscale_factor)
         out = _translate_failure(lambda: fn(self.backend.to_global(x)))
         return self._single(name, out)
@@ -416,8 +589,12 @@ class Engine:
         <= fusion_threshold bucket per dtype), mirroring FuseResponses
         (controller.cc:652-773)."""
         tensors = [jnp.asarray(t) for t in tensors]
+        sub = self._consume_substitute()
         for t in tensors:
             _check_average_dtype(t, op)
+        self._join_sync("grouped_allreduce",
+                        [_join_meta_row(t, int(op)) for t in tensors],
+                        skip=sub)
         pm = self.parameter_manager
         if pm is not None and pm.active:
             # program-ordered autotune step boundary: score the previous
@@ -430,7 +607,7 @@ class Engine:
                                 "grouped_allreduce", t.nbytes)
                  for i, t in enumerate(tensors)]
         self._debug_check(names[0] if names else "empty", "grouped_allreduce",
-                          tensors, op_code=int(op))
+                          tensors, op_code=int(op), wildcard=sub)
         buckets = bucket_by_size(tensors, self.config.fusion_threshold_bytes)
         mesh = self.backend.group_mesh
         hier_local = (self.backend.local_size()
@@ -445,9 +622,14 @@ class Engine:
             # reduce+unpack program — one collective launch, no per-tensor
             # host round-trips (fusion buffer role,
             # collective_operations.cc:38-82).
-            pack_fn = self._builder(("pack", shapes, str(dtype)),
-                                    lambda: C.build_pack(shapes, dtype))
-            packed = _translate_failure(pack_fn, *bucket)
+            from ..ops.pallas_kernels import (pack_pallas,
+                                              pack_pallas_enabled)
+            if pack_pallas_enabled():
+                packed = _translate_failure(pack_pallas, bucket)
+            else:
+                pack_fn = self._builder(("pack", shapes, str(dtype)),
+                                        lambda: C.build_pack(shapes, dtype))
+                packed = _translate_failure(pack_fn, *bucket)
             fn = self._builder(
                 ("fused_allreduce", op, prescale_factor, postscale_factor,
                  shapes, str(dtype), hier_local),
@@ -474,8 +656,11 @@ class Engine:
         (collective_operations.cc:88-195 displacement math): a small size
         exchange first, then pad to max and gather, then slice+concat."""
         x = jnp.asarray(tensor)
+        sub = self._consume_substitute()
         name = self._register(name, "allgather", x.nbytes)
-        self._debug_check(name, "allgather", [x], check_dim0=False)
+        self._join_sync("allgather", [_join_meta_row(x, 0)], skip=sub)
+        self._debug_check(name, "allgather", [x], check_dim0=False,
+                          wildcard=sub)
         mesh = self.backend.group_mesh
         size = self.backend.size()
         d0 = int(x.shape[0]) if x.ndim else 1
@@ -511,8 +696,12 @@ class Engine:
 
     def broadcast(self, tensor, root_rank: int, name: Optional[str] = None) -> Handle:
         x = jnp.asarray(tensor)
+        sub = self._consume_substitute()
         name = self._register(name, "broadcast", x.nbytes)
-        self._debug_check(name, "broadcast", [x], op_code=root_rank)
+        self._join_sync("broadcast", [_join_meta_row(x, root_rank)],
+                        skip=sub, root_rank=root_rank)
+        self._debug_check(name, "broadcast", [x], op_code=root_rank,
+                          wildcard=sub)
         mesh = self.backend.group_mesh
         fn = self._builder(("broadcast", root_rank),
                            lambda: C.build_broadcast(mesh, self._axis(), root_rank))
@@ -524,8 +713,11 @@ class Engine:
         mpi_operations.cc:380 MPI_Alltoallv semantics). Returns handle whose
         result is (received_tensor, recv_splits)."""
         x = jnp.asarray(tensor)
+        sub = self._consume_substitute()
         name = self._register(name, "alltoall", x.nbytes)
-        self._debug_check(name, "alltoall", [x], check_dim0=False)
+        self._join_sync("alltoall", [_join_meta_row(x, 0)], skip=sub)
+        self._debug_check(name, "alltoall", [x], check_dim0=False,
+                          wildcard=sub)
         size = self.backend.size()
         mesh = self.backend.group_mesh
         if splits is None:
@@ -571,9 +763,13 @@ class Engine:
         if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
             raise ValueError(f"reducescatter supports Sum and Average, got {op!r}")
         x = jnp.asarray(tensor)
+        sub = self._consume_substitute()
         _check_average_dtype(x, op)
         name = self._register(name, "reducescatter", x.nbytes)
-        self._debug_check(name, "reducescatter", [x], op_code=int(op))
+        self._join_sync("reducescatter", [_join_meta_row(x, int(op))],
+                        skip=sub)
+        self._debug_check(name, "reducescatter", [x], op_code=int(op),
+                          wildcard=sub)
         size = self.backend.size()
         if int(x.shape[0]) % size != 0:
             raise ValueError("reducescatter requires dim0 divisible by size")
@@ -584,6 +780,8 @@ class Engine:
         return self._single(name, out, replicated=False)
 
     def barrier(self):
+        sub = self._consume_substitute()
+        self._join_sync("barrier", [], skip=sub)
         mesh = self.backend.group_mesh
         fn = self._builder(("barrier",), lambda: C.build_barrier(mesh, self._axis()))
         out = _translate_failure(

@@ -1,0 +1,155 @@
+"""Pallas TPU kernels for the framework's hot inner loops.
+
+Native-kernel layer for the compute path (the reference implements these in
+SIMD C++: the Adasum combine — fused dot/|a|²/|b|² + scaled add — at
+adasum.h:194-336 and its AVX/F16C fp16 specializations at adasum.h:426-546;
+the fusion-buffer pack/unpack memcpys at collective_operations.cc:38-82).
+
+Each kernel has a lax fallback; selection is by :func:`pallas_supported` +
+env knob (HOROVOD_ADASUM_PALLAS / HOROVOD_PALLAS_PACK). Kernels run in
+interpret mode off-TPU so the same code path is testable on the CPU world.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_LANES = 128
+_ROW_BLOCK = 512  # rows per grid step: 512*128*4B = 256 KB/operand in VMEM
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def pallas_supported() -> bool:
+    """Pallas path availability: real TPU (Mosaic) or anywhere via the
+    interpreter (tests)."""
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+def _pad_to_grid(v: jax.Array):
+    n = v.shape[0]
+    per_block = _ROW_BLOCK * _LANES
+    pad = (-n) % per_block
+    if pad:
+        v = jnp.concatenate([v, jnp.zeros((pad,), v.dtype)])
+    rows = v.shape[0] // _LANES
+    return v.reshape(rows, _LANES), n
+
+
+def _triple_kernel(a_ref, b_ref, acc_ref):
+    """Grid-accumulated [dot(a,b), |a|², |b|²] in fp32 — one read of each
+    operand for all three reductions (adasum.h:338-398 computes the same
+    3-vector; the fp16 SIMD kernels at :426-546 accumulate in fp32 too)."""
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        acc_ref[0, 0] = 0.0
+        acc_ref[0, 1] = 0.0
+        acc_ref[0, 2] = 0.0
+
+    af = a_ref[...].astype(jnp.float32)
+    bf = b_ref[...].astype(jnp.float32)
+    acc_ref[0, 0] += jnp.sum(af * bf)
+    acc_ref[0, 1] += jnp.sum(af * af)
+    acc_ref[0, 2] += jnp.sum(bf * bf)
+
+
+def _scale_kernel(coef_ref, a_ref, b_ref, o_ref):
+    ca = coef_ref[0, 0]
+    cb = coef_ref[0, 1]
+    o_ref[...] = (ca * a_ref[...].astype(jnp.float32) +
+                  cb * b_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def adasum_combine_pallas(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Pairwise Adasum combine via two Pallas passes: a fused triple
+    reduction, then the coefficient scaled-add. Semantically identical to
+    :func:`horovod_tpu.ops.adasum.adasum_combine`."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    orig_shape, orig_dtype = a.shape, a.dtype
+    av, n = _pad_to_grid(a.reshape(-1))
+    bv, _ = _pad_to_grid(b.reshape(-1))
+    rows = av.shape[0]
+    grid = rows // _ROW_BLOCK
+
+    triple = pl.pallas_call(
+        _triple_kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((_ROW_BLOCK, _LANES), lambda i: (i, 0)),
+                  pl.BlockSpec((_ROW_BLOCK, _LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((1, 3), jnp.float32),
+        interpret=_interpret(),
+    )(av, bv)
+
+    dot, na, nb = triple[0, 0], triple[0, 1], triple[0, 2]
+    ca = jnp.where(na == 0, 0.0, 1.0 - dot / (2.0 * jnp.where(na == 0, 1.0, na)))
+    cb = jnp.where(nb == 0, 0.0, 1.0 - dot / (2.0 * jnp.where(nb == 0, 1.0, nb)))
+    coef = jnp.stack([ca, cb]).reshape(1, 2)
+
+    out = pl.pallas_call(
+        _scale_kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec((_ROW_BLOCK, _LANES), lambda i: (i, 0)),
+                  pl.BlockSpec((_ROW_BLOCK, _LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((_ROW_BLOCK, _LANES), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(av.shape, orig_dtype),
+        interpret=_interpret(),
+    )(coef, av, bv)
+
+    return out.reshape(-1)[:n].reshape(orig_shape)
+
+
+def adasum_pallas_enabled() -> bool:
+    v = os.environ.get("HOROVOD_ADASUM_PALLAS", "").strip().lower()
+    return v in ("1", "true", "yes", "on") and pallas_supported()
+
+
+# ---------------------------------------------------------------------------
+# Fusion packer (collective_operations.cc:38-82 MemcpyInFusionBuffer role)
+# ---------------------------------------------------------------------------
+
+
+def pack_pallas(tensors):
+    """Pallas fusion packer: one kernel, one DMA-style copy per tensor into
+    the flat buffer (evaluated against the jitted-concat pack; see
+    bench_kernels.py — XLA's fused concat has been faster in practice, so
+    this stays opt-in via HOROVOD_PALLAS_PACK)."""
+    from jax.experimental import pallas as pl
+
+    sizes = [int(np.prod(t.shape)) if t.ndim else 1 for t in tensors]
+    offsets = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+    total = int(sum(sizes))
+    dtype = tensors[0].dtype
+
+    def kernel(*refs):
+        o_ref = refs[-1]
+        for i, (off, sz) in enumerate(zip(offsets, sizes)):
+            o_ref[pl.dslice(int(off), sz)] = refs[i][...].reshape(sz)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((total,), dtype),
+        interpret=_interpret(),
+    )(*[jnp.asarray(t) for t in tensors])
+
+
+def pack_pallas_enabled() -> bool:
+    v = os.environ.get("HOROVOD_PALLAS_PACK", "").strip().lower()
+    return v in ("1", "true", "yes", "on") and pallas_supported()

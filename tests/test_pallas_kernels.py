@@ -1,0 +1,54 @@
+"""Pallas kernel correctness vs the lax reference implementations (interpret
+mode on the CPU world; the same code compiles via Mosaic on real TPU — see
+bench_kernels.py for the measured numbers that set the defaults)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from horovod_tpu.ops.adasum import adasum_combine
+from horovod_tpu.ops.pallas_kernels import (adasum_combine_pallas,
+                                            pack_pallas, pallas_supported)
+
+pytestmark = pytest.mark.skipif(not pallas_supported(),
+                                reason="pallas unavailable")
+
+
+@pytest.mark.parametrize("shape,dtype", [
+    ((1000,), np.float32),
+    ((70000,), np.float32),
+    ((3, 5, 7), np.float32),
+    ((65536,), "bfloat16"),
+])
+def test_adasum_combine_matches_lax(shape, dtype):
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(*shape), dtype)
+    b = jnp.asarray(rng.randn(*shape), dtype)
+    got = np.asarray(adasum_combine_pallas(a, b), np.float32)
+    want = np.asarray(adasum_combine(a, b), np.float32)
+    np.testing.assert_allclose(got, want, rtol=2e-2 if dtype == "bfloat16"
+                               else 2e-5, atol=1e-5)
+
+
+def test_adasum_combine_zero_operand():
+    a = jnp.zeros((512,), jnp.float32)
+    b = jnp.asarray(np.random.RandomState(1).randn(512), jnp.float32)
+    got = np.asarray(adasum_combine_pallas(a, b))
+    want = np.asarray(adasum_combine(a, b))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_env_knob_switches_impl(monkeypatch):
+    monkeypatch.setenv("HOROVOD_ADASUM_PALLAS", "1")
+    a = jnp.asarray(np.random.RandomState(2).randn(256), jnp.float32)
+    out = np.asarray(adasum_combine(a, a))
+    np.testing.assert_allclose(out, np.asarray(a), rtol=1e-5)
+
+
+def test_pack_pallas_matches_concat():
+    rng = np.random.RandomState(3)
+    ts = [jnp.asarray(rng.randn(*s), jnp.float32)
+          for s in [(5,), (3, 4), (2, 2, 2), (1,)]]
+    got = np.asarray(pack_pallas(ts))
+    want = np.concatenate([np.asarray(t).ravel() for t in ts])
+    np.testing.assert_array_equal(got, want)
