@@ -98,6 +98,7 @@ def launch_static(hosts: List[HostInfo], np: int, command: List[str],
                   base_env: Optional[Dict[str, str]] = None,
                   ssh_port: Optional[int] = None,
                   identity_file: Optional[str] = None,
+                  network_interfaces: Optional[List[str]] = None,
                   verbose: bool = False) -> None:
     """Static (fixed world) launch — reference gloo_run.py:215-260.
 
@@ -109,7 +110,7 @@ def launch_static(hosts: List[HostInfo], np: int, command: List[str],
 
     server = RendezvousServer()
     server.start()
-    driver_ip = _driver_ip(hosts)
+    driver_ip = _driver_ip(hosts, network_interfaces)
     # The JAX coordinator lives inside rank 0's process, on rank 0's host —
     # the driver cannot pick a race-free port for it. Rank 0 binds a free
     # port itself and publishes host:port to the rendezvous KV; every other
@@ -149,19 +150,114 @@ def launch_static(hosts: List[HostInfo], np: int, command: List[str],
             f"tpurun: {len(bad)} worker(s) exited non-zero: {bad}")
 
 
-def _driver_ip(hosts: List[HostInfo]) -> str:
+def _driver_ip(hosts: List[HostInfo],
+               interfaces: Optional[List[str]] = None) -> str:
     if all(is_local_host(h.hostname) for h in hosts):
         return "127.0.0.1"
-    # route-based local address discovery (reference driver_service NIC
-    # discovery simplified: one UDP connect tells us the outbound iface)
-    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    # candidate enumeration (+ optional interface pinning) from the NIC
+    # discovery layer; full cross-host intersection needs task agents
+    # (launch_via_task_agents / resolve_driver_ip)
+    from .service import candidate_driver_ips
+    cands = candidate_driver_ips(interfaces)
+    return cands[0]
+
+
+def launch_via_task_agents(agent_addrs: List[str], key: bytes, np: int,
+                           command: List[str],
+                           base_env: Optional[Dict[str, str]] = None,
+                           interfaces: Optional[List[str]] = None,
+                           timeout: float = 600.0,
+                           verbose: bool = False) -> None:
+    """Static launch through pre-started task agents instead of ssh
+    (reference flow: driver_service.py:48 task servers on every host +
+    :135-204 NIC intersection + task_service RunCommand). One agent = one
+    slot; the driver address every host can reach is chosen by probing the
+    rendezvous port through each agent."""
+    import time as _time
+    from .service import TaskClient, resolve_driver_ip
+    if np > len(agent_addrs):
+        raise ValueError(f"need {np} agents, have {len(agent_addrs)}")
+    clients = [TaskClient(a, key, timeout=30) for a in agent_addrs[:np]]
+
+    # Agents on the same host share that host's local-rank space: aggregate
+    # per-host slot counts so two agents on h1 become local ranks 0 and 1
+    # instead of two colliding (h1, 0) slots.
+    host_order: List[str] = []
+    host_slots: Dict[str, int] = {}
+    agent_of_slot: Dict[tuple, TaskClient] = {}
+    for a, c in zip(agent_addrs[:np], clients):
+        host = a.rsplit(":", 1)[0]
+        if host not in host_slots:
+            host_slots[host] = 0
+            host_order.append(host)
+        agent_of_slot[(host, host_slots[host])] = c
+        host_slots[host] += 1
+    hosts = [HostInfo(h, host_slots[h]) for h in host_order]
+
+    server = RendezvousServer()
+    server.start()
     try:
-        s.connect(("8.8.8.8", 80))
-        return s.getsockname()[0]
-    except OSError:
-        return socket.gethostbyname(socket.gethostname())
+        assignments = get_host_assignments(hosts, np, np)
+        server.init(assignments, None)
+        driver_ip = resolve_driver_ip(clients, server.port,
+                                      interfaces=interfaces)
+        if verbose:
+            print(f"[tpurun] task-agent launch; driver {driver_ip}:"
+                  f"{server.port}", file=sys.stderr)
+        slot_clients = [(s, agent_of_slot[(s.hostname, s.local_rank)])
+                        for s in assignments]
+        for slot, client in slot_clients:
+            # base_env is the caller's explicit worker env (the CLI path
+            # pre-filters os.environ); the job secret must never ride along
+            # — the RPC channel is authenticated, not encrypted.
+            env = make_worker_env(slot, COORDINATOR_VIA_RENDEZVOUS,
+                                  driver_ip, server.port, base_env or {})
+            env.pop("HOROVOD_TASK_SECRET", None)
+            res = client.run_command(command, env=env)
+            if not res.get("started"):
+                for _, other in slot_clients:
+                    try:
+                        other.abort_command()
+                    except Exception:
+                        pass
+                raise RuntimeError(
+                    f"tpurun: agent for rank {slot.rank} refused the "
+                    f"command: {res.get('error')}")
+        # shared deadline + failure fan-out: first non-zero exit aborts the
+        # rest (launch_static's failure-Event behavior, gloo_run.py:254-260)
+        deadline = _time.monotonic() + timeout
+        codes: Dict[int, int] = {}
+        pending = {s.rank: c for s, c in slot_clients}
+        failed = None
+        while pending and _time.monotonic() < deadline:
+            for rank, client in list(pending.items()):
+                st = client.command_exit_code()
+                if st["running"] or st["exit_code"] is None:
+                    continue
+                if st.get("error"):
+                    codes[rank] = 127
+                else:
+                    codes[rank] = int(st["exit_code"])
+                del pending[rank]
+                if codes[rank] != 0 and failed is None:
+                    failed = rank
+            if failed is not None:
+                break
+            _time.sleep(0.5)
+        if pending:
+            for rank, client in pending.items():
+                try:
+                    client.abort_command()
+                    codes[rank] = client.wait_for_command_exit_code(
+                        timeout=15)
+                except Exception:
+                    codes[rank] = -1
+        bad = {r: c for r, c in codes.items() if c != 0}
+        if bad:
+            raise RuntimeError(
+                f"tpurun: {len(bad)} worker(s) exited non-zero: {bad}")
     finally:
-        s.close()
+        server.stop()
 
 
 # ---------------------------------------------------------------------------
@@ -178,6 +274,15 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="number of worker processes")
     p.add_argument("-H", "--hosts", default=None,
                    help='host list, e.g. "h1:4,h2:4"; default localhost:np')
+    p.add_argument("--network-interfaces", default=None,
+                   help="comma-separated NICs the driver may advertise "
+                        "(reference --network-interface); candidates are "
+                        "intersected across hosts when task agents are used")
+    p.add_argument("--task-agents", default=None,
+                   help="comma-separated pre-started task-agent addresses "
+                        "(host:port); launches through the signed RPC "
+                        "channel instead of ssh. Requires "
+                        "HOROVOD_TASK_SECRET (hex) in the environment.")
     p.add_argument("--hostfile", default=None,
                    help="hostfile with one 'host slots=N' per line")
     p.add_argument("-p", "--ssh-port", type=int, default=None)
@@ -314,9 +419,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         hosts = parse_hosts(args.hosts)
     else:
         hosts = [HostInfo("localhost", args.num_proc)]
+    ifaces = (args.network_interfaces.split(",")
+              if args.network_interfaces else None)
+    if args.task_agents:
+        key_hex = os.environ.get("HOROVOD_TASK_SECRET")
+        if not key_hex:
+            print("tpurun: --task-agents needs HOROVOD_TASK_SECRET (hex)",
+                  file=sys.stderr)
+            return 2
+        # ship only what workers need, never the driver's whole environment
+        # (it contains HOROVOD_TASK_SECRET; the RPC is signed, not encrypted)
+        agent_env = {k: v for k, v in base_env.items()
+                     if k.startswith("HOROVOD") or k in
+                     ("PATH", "PYTHONPATH", "XLA_FLAGS", "JAX_PLATFORMS",
+                      "TPU_NAME", "LD_LIBRARY_PATH")}
+        agent_env.pop("HOROVOD_TASK_SECRET", None)
+        launch_via_task_agents(args.task_agents.split(","),
+                               bytes.fromhex(key_hex), args.num_proc,
+                               command, agent_env, interfaces=ifaces,
+                               verbose=args.verbose)
+        return 0
     launch_static(hosts, args.num_proc, command, base_env,
                   ssh_port=args.ssh_port,
                   identity_file=args.ssh_identity_file,
+                  network_interfaces=ifaces,
                   verbose=args.verbose)
     return 0
 

@@ -59,19 +59,25 @@ def terminate_process_group(proc: subprocess.Popen,
 def execute(command, env: Optional[dict] = None,
             stdout=None, stderr=None, index: Optional[int] = None,
             events=None, prefix_output_with_timestamp: bool = False,
-            shell: bool = True) -> int:
+            shell: Optional[bool] = None, on_start=None) -> int:
     """Run ``command`` in its own process group; returns the exit code.
 
     ``events`` is an optional list of ``threading.Event``s — when any is set,
     the process group is terminated (the reference uses this to fan a single
     "job failed" event out to every ssh thread, gloo_run.py:254-260).
+    ``on_start(pid)`` is invoked once the process exists (the task service
+    uses it to support abort, task_service.py:25-111 role).
     """
     stdout = stdout or sys.stdout
     stderr = stderr or sys.stderr
+    if shell is None:
+        shell = isinstance(command, str)
     proc = subprocess.Popen(
         command, shell=shell, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         start_new_session=True)
+    if on_start is not None:
+        on_start(proc.pid)
 
     prefix = str(index) if index is not None else None
     pumps = [
