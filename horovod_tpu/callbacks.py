@@ -17,7 +17,7 @@ from __future__ import annotations
 import math
 import pickle
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
 import numpy as np
 
@@ -36,11 +36,75 @@ class TrainLoopState:
 def scaled_schedule(base_schedule, loop_state: TrainLoopState):
     """Wrap an optax schedule (or float) so the callbacks' ``lr_scale``
     multiplier applies. NOTE: the scale is read at trace time only if you
-    re-jit; pass it as a step-input for fully dynamic control."""
+    re-jit — prefer :func:`scaled_lr`, which is fully dynamic under jit and
+    is what the LR callbacks drive by default."""
     def sched(count):
         base = base_schedule(count) if callable(base_schedule) else base_schedule
         return base * loop_state.lr_scale
     return sched
+
+
+class ScaledLRState(NamedTuple):
+    """Optimizer-state node carrying the live LR multiplier (a *dynamic*
+    jit input — mutating it between steps needs no re-trace, unlike a
+    Python-closure schedule)."""
+    inner_state: Any
+    scale: Any
+
+
+def scaled_lr(inner):
+    """Wrap an optax optimizer so its updates are multiplied by a scale
+    stored in the optimizer state. This is the jit-safe carrier for the LR
+    schedule/warmup callbacks (the reference mutates
+    ``model.optimizer.lr`` via the Keras backend,
+    _keras/callbacks.py:90-186; under XLA the equivalent is a state leaf,
+    not a trace-time constant).
+
+        opt = hvd.callbacks.scaled_lr(optax.sgd(0.1))
+        ... loop: callbacks update state.lr_scale; the loop (or
+        CallbackList via TrainLoopState.opt_state) grafts it with
+        set_lr_scale(opt_state, scale) ...
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def init_fn(params):
+        return ScaledLRState(inner.init(params), jnp.ones((), jnp.float32))
+
+    def update_fn(grads, state, params=None):
+        updates, new_inner = inner.update(grads, state.inner_state, params)
+
+        def scale_one(u):
+            # multiply in the promoted dtype: bf16 updates scale in f32,
+            # f64 updates stay f64 (no silent precision loss under x64)
+            ct = jnp.promote_types(u.dtype, jnp.float32)
+            return (u.astype(ct) * state.scale.astype(ct)).astype(u.dtype)
+
+        updates = jax.tree_util.tree_map(scale_one, updates)
+        return updates, ScaledLRState(new_inner, state.scale)
+
+    import optax
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def set_lr_scale(opt_state, scale: float):
+    """Return ``opt_state`` with every :class:`ScaledLRState` node's scale
+    replaced — a functional setter usable between jitted steps (same state
+    structure, so no recompilation). Uses jax's own pytree traversal so the
+    node is found inside ANY registered container (optax wrappers, flax
+    structs, FrozenDicts, ...), not just builtin tuples/dicts."""
+    import jax
+    import jax.numpy as jnp
+    new_scale = jnp.asarray(scale, jnp.float32)
+
+    def fix(node):
+        if isinstance(node, ScaledLRState):
+            return ScaledLRState(set_lr_scale(node.inner_state, scale),
+                                 new_scale)
+        return node
+
+    return jax.tree_util.tree_map(
+        fix, opt_state, is_leaf=lambda n: isinstance(n, ScaledLRState))
 
 
 class Callback:
@@ -140,16 +204,30 @@ class LearningRateScheduleCallback(Callback):
             return False
         return self.end_epoch is None or epoch < self.end_epoch
 
+    def _apply(self, state):
+        # graft into the optimizer state so a jitted step picks the new
+        # scale up as a dynamic input (no re-trace; see scaled_lr)
+        if state.opt_state is not None:
+            state.opt_state = set_lr_scale(state.opt_state, state.lr_scale)
+
     def on_epoch_begin(self, state):
         self._batch = 0
         if self.staircase and self._in_range(state.epoch):
-            state.lr_scale = float(self.multiplier(state.epoch))
+            new = float(self.multiplier(state.epoch))
+            if new != state.lr_scale:
+                state.lr_scale = new
+                self._apply(state)
 
     def on_batch_begin(self, state, batch):
         if not self.staircase and self.steps_per_epoch and \
                 self._in_range(state.epoch):
             frac = state.epoch + batch / self.steps_per_epoch
-            state.lr_scale = float(self.multiplier(frac))
+            new = float(self.multiplier(frac))
+            # graft only on change: rebuilding the opt_state pytree per
+            # batch is pure overhead on LR plateaus
+            if new != state.lr_scale:
+                state.lr_scale = new
+                self._apply(state)
 
 
 class LearningRateWarmupCallback(LearningRateScheduleCallback):
@@ -222,6 +300,7 @@ class CommitStateCallback(Callback):
 
 __all__ = [
     "TrainLoopState", "Callback", "CallbackList", "scaled_schedule",
+    "scaled_lr", "set_lr_scale", "ScaledLRState",
     "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
     "LearningRateScheduleCallback", "LearningRateWarmupCallback",
     "BestModelCheckpoint", "CommitStateCallback",

@@ -303,8 +303,14 @@ class Backend:
         for s in garr.addressable_shards:
             if s.index[0].start == self._rank or self._size == 1:
                 return s.data[0]
-        # Fallback: single addressable shard
-        return garr.addressable_shards[0].data[0]
+        # A missing shard means the array isn't laid out the way this rank
+        # believes — reading any other shard would be silent data
+        # corruption (ADVICE r1: fail loudly instead).
+        raise HorovodInternalError(
+            f"rank {self._rank}: no addressable shard for this rank in a "
+            f"stacked global array (shape {garr.shape}; "
+            f"{len(garr.addressable_shards)} addressable shards) — "
+            f"world/mesh mismatch?")
 
     def from_replicated(self, garr: jax.Array):
         """Extract a replicated (out_specs=P()) result: the addressable shard

@@ -225,6 +225,9 @@ class Engine:
         # observability hooks, wired by GlobalState when timeline/stall are on
         self.on_enqueue: Optional[Callable[[str, str, int], None]] = None
         self.on_done: Optional[Callable[[str], None]] = None
+        # per-activity sub-span hook (timeline ACTIVITY events, the nested
+        # spans of timeline.h:77 NEGOTIATING->TOP_LEVEL->ACTIVITY)
+        self.on_activity: Optional[Callable[[str, str, float], None]] = None
         # autotuner (parameter_manager.h): wired by GlobalState when
         # HOROVOD_AUTOTUNE=1; scores throughput per drain-cycle and retunes
         # fusion_threshold / cycle_time
@@ -266,6 +269,7 @@ class Engine:
 
     def _builder(self, key: tuple, make: Callable):
         fn = self._builders.get(key)
+        self._last_builder_fresh = fn is None
         if fn is None:
             # The builder cache is the ResponseCache analog
             # (response_cache.h:45-102); HOROVOD_CACHE_CAPACITY bounds it the
@@ -301,6 +305,28 @@ class Engine:
     def _track(self, name: str, h: Handle):
         with self._lock:
             self._outstanding[name] = h
+
+    def _dispatch(self, names, fn, *args):
+        """Dispatch with failure translation + a timeline ACTIVITY span per
+        involved tensor (QUEUE/MEMCPY/NCCL_* span analog, common.h:32-62;
+        the reference records activities for every tensor of a fused
+        response). A fresh builder means this call traced + compiled, which
+        dwarfs a real dispatch — labeled separately so timelines stay
+        readable."""
+        activity = ("XLA_COMPILE_AND_DISPATCH"
+                    if getattr(self, "_last_builder_fresh", False)
+                    else "XLA_DISPATCH")
+        self._last_builder_fresh = False
+        if isinstance(names, str):
+            names = [names]
+        t0 = time.perf_counter()
+        try:
+            return _translate_failure(fn, *args)
+        finally:
+            if self.on_activity is not None:
+                dur = (time.perf_counter() - t0) * 1e6
+                for n in names:
+                    self.on_activity(n, activity, dur)
 
     # -- Join protocol (operations.cc:1004-1040, tensor_queue.h:39-41) ------
 
@@ -578,7 +604,7 @@ class Engine:
         self._debug_check(name, "allreduce", [x], op_code=int(op),
                           wildcard=sub)
         fn = self._allreduce_builder(op, prescale_factor, postscale_factor)
-        out = _translate_failure(lambda: fn(self.backend.to_global(x)))
+        out = self._dispatch(name, lambda: fn(self.backend.to_global(x)))
         return self._single(name, out)
 
     def grouped_allreduce(self, tensors: Sequence, name: Optional[str] = None,
@@ -636,8 +662,8 @@ class Engine:
                 lambda: C.build_fused_allreduce(
                     mesh, self._axis(), op, shapes, dtype,
                     prescale_factor, postscale_factor, hier_local))
-            outs = _translate_failure(
-                lambda: fn(self.backend.to_global(packed)))
+            outs = self._dispatch([names[i] for i in idxs],
+                                  lambda: fn(self.backend.to_global(packed)))
             group = LaunchGroup(outs[-1])
             for pos, i in enumerate(idxs):
                 results[i] = (outs[pos], group)
@@ -679,7 +705,7 @@ class Engine:
         else:
             fn = self._builder(("allgather",),
                                lambda: C.build_allgather(mesh, self._axis()))
-        out = _translate_failure(lambda: fn(self.backend.to_global(xp)))
+        out = self._dispatch(name, lambda: fn(self.backend.to_global(xp)))
 
         def extract(gs):
             local = self.backend.from_replicated(gs[0])  # (size*max_d0, *s)
@@ -705,7 +731,7 @@ class Engine:
         mesh = self.backend.group_mesh
         fn = self._builder(("broadcast", root_rank),
                            lambda: C.build_broadcast(mesh, self._axis(), root_rank))
-        out = _translate_failure(lambda: fn(self.backend.to_global(x)))
+        out = self._dispatch(name, lambda: fn(self.backend.to_global(x)))
         return self._single(name, out)
 
     def alltoall(self, tensor, splits=None, name: Optional[str] = None) -> Handle:
@@ -744,7 +770,7 @@ class Engine:
             jnp.pad(c, [(0, max_chunk - c.shape[0])] + [(0, 0)] * (x.ndim - 1))
             for c in chunks]) if size > 1 else x
         fn = self._builder(("alltoall",), lambda: C.build_alltoall(mesh, self._axis()))
-        out = _translate_failure(lambda: fn(self.backend.to_global(padded)))
+        out = self._dispatch(name, lambda: fn(self.backend.to_global(padded)))
 
         def extract(gs):
             local = self.backend.from_global(gs[0])  # (size*max_chunk, *s)
@@ -776,7 +802,7 @@ class Engine:
         mesh = self.backend.group_mesh
         fn = self._builder(("reducescatter", op),
                            lambda: C.build_reducescatter(mesh, self._axis(), op))
-        out = _translate_failure(lambda: fn(self.backend.to_global(x)))
+        out = self._dispatch(name, lambda: fn(self.backend.to_global(x)))
         return self._single(name, out, replicated=False)
 
     def barrier(self):

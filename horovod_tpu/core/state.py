@@ -84,8 +84,13 @@ class GlobalState:
             if stall is not None:
                 stall.record_done(name)
 
+        def on_activity(name, activity, dur_us):
+            if timeline is not None:
+                timeline.record_activity(name, activity, dur_us)
+
         engine.on_enqueue = on_enqueue
         engine.on_done = on_done
+        engine.on_activity = on_activity
 
     def shutdown(self):
         with self._lock:
