@@ -52,3 +52,16 @@ def test_elastic_example_single_process():
               "--total-batches", "20", "--batch-size", "16"])
     assert r.returncode == 0, r.stderr[-2000:]
     assert "done:" in r.stdout, r.stdout
+
+
+def test_scaling_benchmark_example():
+    r = _run([os.path.join(EXAMPLES, "scaling_benchmark.py"),
+              "--sizes", "1,2", "--bytes", "1048576", "--iters", "2",
+              "--batch-per-chip", "8"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 4, r.stdout
+    import json
+    recs = [json.loads(l) for l in lines]
+    assert {rec["bench"] for rec in recs} == {"allreduce",
+                                             "weak_scaling_train"}
