@@ -65,6 +65,7 @@ def main():
         if not sizes:
             raise SystemExit(
                 f"no requested world size fits the {n_dev} visible devices")
+        sizes = sorted(set(sizes))   # efficiency baseline must run first
     else:
         sizes = [s for s in (2 ** i for i in range(n_dev.bit_length()))
                  if s <= n_dev]
@@ -74,12 +75,14 @@ def main():
     for n in sizes:
         mesh = Mesh(np.array(devices[:n]), ("data",))
 
-        # -- allreduce bandwidth ----------------------------------------
+        # -- allreduce bandwidth (through the framework's builder, so the
+        # metric certifies the framework path, not raw XLA) ---------------
+        from horovod_tpu.ops.collectives import build_allreduce
+        from horovod_tpu.common.reduce_ops import ReduceOp
         buf = jax.device_put(
             jnp.ones((n, n_elems), jnp.float32),
             NamedSharding(mesh, P("data")))
-        ar = jax.jit(shard_map(lambda x: jax.lax.psum(x[0], "data"),
-                               mesh=mesh, in_specs=P("data"), out_specs=P()))
+        ar = build_allreduce(mesh, "data", ReduceOp.SUM)
         out = ar(buf)
         _fetch(out)
         t0 = time.perf_counter()

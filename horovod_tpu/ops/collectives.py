@@ -44,8 +44,9 @@ def allreduce_p(x, axis_name: str, op: ReduceOp = ReduceOp.SUM,
     """Allreduce of ``x`` over mesh axis ``axis_name``.
 
     Average divides by the axis size (reference divisor logic:
-    torch/mpi_ops.py:79-103). PRODUCT has no direct XLA primitive; it is
-    computed in sign/log space to stay a single psum.
+    torch/mpi_ops.py:79-103). PRODUCT has no direct XLA primitive; it is an
+    all_gather + per-rank multiply (exact for every dtype, incl. integers),
+    finalized by a masked psum so the output is provably replicated.
     """
     if op == ReduceOp.AVERAGE and jnp.issubdtype(x.dtype, jnp.integer):
         raise ValueError(
@@ -62,13 +63,18 @@ def allreduce_p(x, axis_name: str, op: ReduceOp = ReduceOp.SUM,
     elif op == ReduceOp.MAX:
         out = lax.pmax(x, axis_name)
     elif op == ReduceOp.PRODUCT:
-        # prod = sign * exp(psum(log|x|)); exact zeros handled via a zero-count psum.
-        sign = lax.psum(jnp.where(x < 0, 1, 0), axis_name) % 2
-        zeros = lax.psum(jnp.where(x == 0, 1, 0), axis_name)
-        mag = lax.psum(jnp.log(jnp.where(x == 0, 1.0, jnp.abs(x)).astype(jnp.float32)),
+        # No XLA product-allreduce primitive: gather the n contributions and
+        # multiply — exact for every dtype (incl. integers, which a
+        # log-space psum construction would only approximate); keep the
+        # input dtype (jnp.prod would promote int8/16 to int32). The masked
+        # psum re-broadcast costs one extra collective but makes the result
+        # provably replicated for shard_map's VMA checker at EVERY call
+        # site (PRODUCT is a rare op).
+        prod = jnp.prod(lax.all_gather(x, axis_name, axis=0, tiled=False),
+                        axis=0).astype(x.dtype)
+        idx = lax.axis_index(axis_name)
+        out = lax.psum(jnp.where(idx == 0, prod, jnp.zeros_like(prod)),
                        axis_name)
-        out = jnp.where(zeros > 0, 0.0,
-                        jnp.where(sign == 1, -1.0, 1.0) * jnp.exp(mag)).astype(x.dtype)
     else:
         raise ValueError(f"unsupported reduce op {op!r} in allreduce_p")
     if postscale_factor != 1.0:

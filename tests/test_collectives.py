@@ -39,16 +39,26 @@ def test_allreduce_sum(mesh8, dtype, shape):
                                rtol=2e-2 if dtype == ml_dtypes.bfloat16 else 1e-5)
 
 
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int32])
 @pytest.mark.parametrize("op,npfn", [
     (ReduceOp.MIN, np.min), (ReduceOp.MAX, np.max), (ReduceOp.PRODUCT, np.prod)])
-def test_allreduce_minmaxprod(mesh8, op, npfn):
+def test_allreduce_minmaxprod(mesh8, op, npfn, dtype):
     n = 8
     rng = np.random.RandomState(1)
-    data = rng.uniform(-2, 2, size=(n, 13)).astype(np.float32)
+    if np.issubdtype(dtype, np.integer):
+        data = rng.randint(-3, 4, size=(n, 13)).astype(dtype)
+    else:
+        data = rng.uniform(-2, 2, size=(n, 13)).astype(dtype)
     fn = C.build_allreduce(mesh8, WORLD_AXIS, op)
     out = np.asarray(fn(stacked(mesh8, data)))
-    expected = npfn(data, axis=0)
-    np.testing.assert_allclose(out, expected, rtol=1e-4)
+    expected = npfn(data.astype(np.float64), axis=0).astype(dtype)
+    if np.issubdtype(dtype, np.integer):
+        # min/max/product must be EXACT for integers (reference grids
+        # include integer dtypes; a log-space product would only
+        # approximate)
+        np.testing.assert_array_equal(out, expected)
+    else:
+        np.testing.assert_allclose(out, expected, rtol=1e-4)
 
 
 def test_allreduce_average_and_scales(mesh8):

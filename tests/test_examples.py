@@ -65,3 +65,24 @@ def test_scaling_benchmark_example():
     recs = [json.loads(l) for l in lines]
     assert {rec["bench"] for rec in recs} == {"allreduce",
                                              "weak_scaling_train"}
+
+
+@pytest.mark.integration
+def test_mnist_under_tpurun_cli():
+    """Genuine CLI end-to-end: `tpurun -np 2 python examples/mnist_mlp.py`
+    (the reference's keystone `horovodrun -np 2` pattern, SURVEY §4)."""
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "HOROVOD_STALL_CHECK_DISABLE": "1",
+    })
+    r = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         sys.executable, os.path.join(EXAMPLES, "mnist_mlp.py"),
+         "--epochs", "1", "--batch-size", "1024"],
+        env=env, timeout=420, capture_output=True, text=True)
+    assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-2000:])
+    assert "size=2" in r.stdout, r.stdout
