@@ -49,9 +49,10 @@ def main():
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from horovod_tpu import optimizer as hvd_opt
-    from horovod_tpu.common.reduce_ops import Average
+    from horovod_tpu.common.reduce_ops import Average, ReduceOp
     from horovod_tpu.models.mlp import (init_mlp, mlp_forward,
                                         softmax_cross_entropy)
+    from horovod_tpu.ops.collectives import build_allreduce
 
     devices = jax.devices()
     n_dev = len(devices)
@@ -77,8 +78,6 @@ def main():
 
         # -- allreduce bandwidth (through the framework's builder, so the
         # metric certifies the framework path, not raw XLA) ---------------
-        from horovod_tpu.ops.collectives import build_allreduce
-        from horovod_tpu.common.reduce_ops import ReduceOp
         buf = jax.device_put(
             jnp.ones((n, n_elems), jnp.float32),
             NamedSharding(mesh, P("data")))

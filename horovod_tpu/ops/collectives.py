@@ -72,9 +72,7 @@ def allreduce_p(x, axis_name: str, op: ReduceOp = ReduceOp.SUM,
         # site (PRODUCT is a rare op).
         prod = jnp.prod(lax.all_gather(x, axis_name, axis=0, tiled=False),
                         axis=0).astype(x.dtype)
-        idx = lax.axis_index(axis_name)
-        out = lax.psum(jnp.where(idx == 0, prod, jnp.zeros_like(prod)),
-                       axis_name)
+        out = broadcast_p(prod, axis_name, 0)
     else:
         raise ValueError(f"unsupported reduce op {op!r} in allreduce_p")
     if postscale_factor != 1.0:

@@ -1,0 +1,20 @@
+"""Parallelism strategies beyond data parallelism (SURVEY §2.8: the
+reference is DP-only; these are the TPU-native extensions its alltoall /
+point-to-point primitive set was the transport for).
+
+- :mod:`.mesh` — world/device mesh construction.
+- :mod:`.ring_attention` — sequence parallelism via ppermute K/V rotation.
+- :mod:`.ulysses` — sequence parallelism via head/sequence all-to-all.
+- :mod:`.moe` — expert parallelism (Switch top-1, all-to-all dispatch).
+"""
+
+from .mesh import WORLD_AXIS, world_mesh
+from .ring_attention import local_attention, ring_attention_p
+from .ulysses import ulysses_attention_p
+from .moe import MoEParams, init_moe, moe_layer_p
+
+__all__ = [
+    "WORLD_AXIS", "world_mesh",
+    "local_attention", "ring_attention_p", "ulysses_attention_p",
+    "MoEParams", "init_moe", "moe_layer_p",
+]

@@ -1,0 +1,112 @@
+"""Expert parallelism: Switch-style top-1 MoE with all-to-all dispatch.
+
+SURVEY §2.8: the reference has no EP, "but **alltoall** — EP's transport
+primitive — is first-class" (operations.cc:951, NCCLAlltoall). This module
+builds the EP layer natively on ``lax.all_to_all`` over an ``expert`` mesh
+axis: tokens are routed top-1, packed into per-expert capacity slots,
+exchanged so each device holds the tokens for ITS experts (from every peer),
+run through the local expert FFNs as one batched einsum (MXU-friendly:
+[E_local, n·C, d] x [E_local, d, f]), and exchanged back.
+
+Capacity semantics follow Switch Transformer: per source device each expert
+accepts at most ``ceil(T·capacity_factor/E)`` tokens; overflow tokens
+contribute zero (the caller's residual connection carries them through).
+The auxiliary load-balancing loss is the standard fraction·probability dot.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class MoEParams(NamedTuple):
+    router: jax.Array   # [d_model, n_experts_total]
+    w_in: jax.Array     # [E_local, d_model, d_ff]
+    w_out: jax.Array    # [E_local, d_ff, d_model]
+
+
+def init_moe(key, d_model: int, d_ff: int, n_experts: int,
+             n_expert_shards: int = 1, dtype=jnp.float32) -> MoEParams:
+    """Per-shard expert weights: call under shard_map (or slice per rank)."""
+    if n_experts % n_expert_shards:
+        raise ValueError(f"n_experts {n_experts} must divide over "
+                         f"{n_expert_shards} expert shards")
+    e_local = n_experts // n_expert_shards
+    k1, k2, k3 = jax.random.split(key, 3)
+    return MoEParams(
+        router=jax.random.normal(k1, (d_model, n_experts), dtype) * 0.02,
+        w_in=jax.random.normal(k2, (e_local, d_model, d_ff), dtype)
+        * math.sqrt(2.0 / d_model),
+        w_out=jax.random.normal(k3, (e_local, d_ff, d_model), dtype)
+        * math.sqrt(2.0 / d_ff))
+
+
+def moe_layer_p(x, params: MoEParams, axis_name: str, axis_size: int,
+                capacity_factor: float = 1.25) -> Tuple[jax.Array, jax.Array]:
+    """Top-1 MoE over ``axis_name`` (size may be 1 = no EP).
+
+    Args:
+      x: local tokens ``[T, d_model]`` (flatten batch×seq first).
+      params: this shard's :class:`MoEParams` (experts sharded over the
+        axis; router replicated).
+
+    Returns ``(y, aux_loss)``: y ``[T, d_model]`` (zeros for dropped
+    tokens — add the residual outside), and the scalar load-balance loss.
+    """
+    n = axis_size
+    t, d = x.shape
+    e_local = params.w_in.shape[0]
+    e_total = e_local * n
+    capacity = max(int(math.ceil(t * capacity_factor / e_total)), 1)
+
+    logits = (x @ params.router.astype(x.dtype)).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)              # [T, E]
+    expert = jnp.argmax(probs, axis=-1)                  # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+
+    # Switch aux loss: E · Σ_e (fraction of tokens on e)·(mean prob of e)
+    onehot = jax.nn.one_hot(expert, e_total, dtype=jnp.float32)
+    aux = e_total * jnp.sum(jnp.mean(onehot, axis=0) *
+                            jnp.mean(probs, axis=0))
+
+    # capacity slotting: position of each token in its expert's queue
+    pos_in_expert = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot,
+                            axis=-1).astype(jnp.int32) - 1     # [T]
+    keep = pos_in_expert < capacity
+    slot = jnp.where(keep, pos_in_expert, capacity - 1)
+
+    # dispatch buffer [E, C, d]; dropped tokens masked to zero contributions
+    disp = jnp.zeros((e_total, capacity, d), x.dtype)
+    disp = disp.at[expert, slot].add(x * keep[:, None].astype(x.dtype))
+
+    if n > 1:
+        # [E, C, d] -> [n, E_local·C, d]; slice i goes to expert shard i
+        send = disp.reshape(n, e_local * capacity, d)
+        recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0,
+                              tiled=False)                # [n, E_local·C, d]
+        expert_in = recv.reshape(n, e_local, capacity, d) \
+            .transpose(1, 0, 2, 3).reshape(e_local, n * capacity, d)
+    else:
+        expert_in = disp  # [E_local(=E), C, d]
+
+    # batched expert FFN on the MXU: [E_local, nC, d]·[E_local, d, f]
+    h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", expert_in,
+                               params.w_in.astype(x.dtype)))
+    y = jnp.einsum("ecf,efd->ecd", h, params.w_out.astype(x.dtype))
+
+    if n > 1:
+        back = y.reshape(e_local, n, capacity, d).transpose(1, 0, 2, 3) \
+            .reshape(n, e_local * capacity, d)
+        combined = lax.all_to_all(back, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=False) \
+            .reshape(e_total, capacity, d)
+    else:
+        combined = y
+
+    out = combined[expert, slot] * (gate * keep).astype(x.dtype)[:, None]
+    return out, aux

@@ -32,8 +32,16 @@ def test_allreduce_sum(mesh8, dtype, shape):
     n = 8
     rng = np.random.RandomState(0)
     data = (rng.randint(-10, 10, size=(n,) + shape)).astype(dtype)
-    fn = C.build_allreduce(mesh8, WORLD_AXIS, ReduceOp.SUM)
-    out = np.asarray(fn(stacked(mesh8, data))).astype(np.float64)  # replicated
+    # float64 must actually run in float64 (with x64 off, jnp.asarray would
+    # silently downcast and the case would duplicate float32)
+    import contextlib
+    ctx = (jax.enable_x64() if dtype == np.float64
+           else contextlib.nullcontext())
+    with ctx:
+        fn = C.build_allreduce(mesh8, WORLD_AXIS, ReduceOp.SUM)
+        garr = stacked(mesh8, data)
+        assert garr.dtype == dtype, (garr.dtype, dtype)
+        out = np.asarray(fn(garr)).astype(np.float64)  # replicated
     expected = data.astype(np.float64).sum(axis=0)
     np.testing.assert_allclose(out, expected,
                                rtol=2e-2 if dtype == ml_dtypes.bfloat16 else 1e-5)

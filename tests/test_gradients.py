@@ -83,8 +83,7 @@ def test_reducescatter_gradient(mesh8):
     g = np.asarray(jax.grad(lambda s: jnp.sum(fn(s) * w))(stacked(mesh8, x)))
     expected = np.asarray(w).reshape(N, 2)  # shard j's grad lands on row j
     for r in range(N):
-        np.testing.assert_allclose(g[r], expected[None].reshape(N, 1, 2)
-                                   .squeeze(1), rtol=1e-5)
+        np.testing.assert_allclose(g[r], expected, rtol=1e-5)
 
 
 def test_spmd_primitive_allreduce_grad_inside_shard_map(mesh8):

@@ -1,0 +1,87 @@
+"""Expert-parallel MoE tests: the EP-sharded layer (all-to-all dispatch over
+4 expert shards) must match the single-shard reference bit-for-bit given the
+same expert weights, gradients must flow, and capacity overflow must drop
+tokens to zero (Switch semantics)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu.parallel.moe import MoEParams, init_moe, moe_layer_p
+
+E, D, F = 8, 16, 32
+N_SHARD = 4
+
+
+def _mesh(n=N_SHARD):
+    return jax.sharding.Mesh(np.array(jax.devices()[:n]), ("expert",))
+
+
+def _params(seed=0):
+    """Full (unsharded) params with E experts."""
+    return init_moe(jax.random.PRNGKey(seed), D, F, E, n_expert_shards=1)
+
+
+def _shard_params(full: MoEParams, n=N_SHARD):
+    e_local = E // n
+    return [MoEParams(full.router,
+                      full.w_in[i * e_local:(i + 1) * e_local],
+                      full.w_out[i * e_local:(i + 1) * e_local])
+            for i in range(n)]
+
+
+def test_ep_matches_single_shard():
+    full = _params()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(64, D).astype(np.float32))
+    ref, aux_ref = moe_layer_p(x, full, "none", 1, capacity_factor=8.0)
+
+    mesh = _mesh()
+    shards = _shard_params(full)
+    w_in = jnp.stack([s.w_in for s in shards])    # [n, E/n, D, F]
+    w_out = jnp.stack([s.w_out for s in shards])
+
+    def body(x, router, w_in, w_out):
+        p = MoEParams(router, w_in[0], w_out[0])
+        y, aux = moe_layer_p(x, p, "expert", N_SHARD, capacity_factor=8.0)
+        return y, aux
+
+    fn = jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(), P("expert"), P("expert")),
+        out_specs=(P(), P()), check_vma=False))
+    y, aux = fn(x, full.router, w_in, w_out)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=2e-5,
+                               atol=2e-6)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
+
+
+def test_gradients_flow_through_dispatch():
+    full = _params(seed=1)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(32, D).astype(np.float32))
+
+    def loss(params, x):
+        y, aux = moe_layer_p(x, params, "none", 1, capacity_factor=8.0)
+        return jnp.sum(y ** 2) + 0.01 * aux
+
+    g = jax.grad(loss)(full, x)
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert np.isfinite(np.asarray(leaf)).all()
+    # router receives gradient through the gate
+    assert float(jnp.abs(g.router).sum()) > 0
+
+
+def test_capacity_overflow_drops_tokens():
+    """With capacity 1 and many tokens on one expert, overflow outputs are
+    exactly zero (residual carries them)."""
+    full = _params(seed=2)
+    # tokens engineered to route identically: identical inputs
+    x = jnp.tile(jnp.asarray(np.random.RandomState(3).randn(1, D),
+                             jnp.float32), (16, 1))
+    y, _ = moe_layer_p(x, full, "none", 1, capacity_factor=1.0 / 16 * E)
+    # capacity = ceil(16 * (E/16) / E) = 1 → only the first token survives
+    nz = np.flatnonzero(np.abs(np.asarray(y)).sum(axis=1) > 1e-9)
+    assert len(nz) == 1 and nz[0] == 0, nz
