@@ -6,15 +6,19 @@ point-to-point primitive set was the transport for).
 - :mod:`.ring_attention` — sequence parallelism via ppermute K/V rotation.
 - :mod:`.ulysses` — sequence parallelism via head/sequence all-to-all.
 - :mod:`.moe` — expert parallelism (Switch top-1, all-to-all dispatch).
+- :mod:`.pipeline` — GPipe-style microbatched pipeline parallelism.
 """
 
 from .mesh import WORLD_AXIS, world_mesh
 from .ring_attention import local_attention, ring_attention_p
 from .ulysses import ulysses_attention_p
 from .moe import MoEParams, init_moe, moe_layer_p
+from .pipeline import (merge_microbatches, pipeline_apply_p,
+                       split_microbatches)
 
 __all__ = [
     "WORLD_AXIS", "world_mesh",
     "local_attention", "ring_attention_p", "ulysses_attention_p",
     "MoEParams", "init_moe", "moe_layer_p",
+    "pipeline_apply_p", "split_microbatches", "merge_microbatches",
 ]

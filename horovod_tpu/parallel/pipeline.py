@@ -1,0 +1,89 @@
+"""Pipeline parallelism: GPipe-style microbatched stage pipeline over a mesh
+axis.
+
+Beyond the reference's DP-only surface (SURVEY §2.8: no PP), built the
+TPU-native way: the L layers are split into ``n_stages`` contiguous stages,
+one per device along the ``pipe`` axis; microbatches stream through a
+``lax.scan`` of pipeline ticks, and activations hop stage→stage with a
+single ``lax.ppermute`` per tick (one ICI neighbor link). The schedule is
+the classic fill-drain ladder: ``n_micro + n_stages − 1`` ticks, bubble
+fraction ``(n_stages−1)/(n_micro+n_stages−1)``.
+
+Differentiable end-to-end: AD transposes the ppermute (reverse hop) and the
+scan, so pipeline-parallel training needs no hand-written backward schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.collectives import broadcast_p
+
+
+def pipeline_apply_p(stage_fn: Callable, stage_params, micro_inputs,
+                     axis_name: str, n_stages: int):
+    """Run the pipeline inside ``shard_map`` (the ``pipe`` axis manual).
+
+    Args:
+      stage_fn: ``(stage_params, x) -> y`` — one stage's computation; must
+        preserve the activation shape ``[mb, ...]`` (stages are homogeneous,
+        the usual PP layout for stacked transformer blocks).
+      stage_params: THIS stage's parameter pytree (shard the stacked
+        ``[n_stages, ...]`` params over the pipe axis and index block 0).
+      micro_inputs: ``[n_micro, mb, ...]`` microbatches (replicated; only
+        stage 0 reads them).
+      n_stages: size of the pipe axis.
+
+    Returns ``[n_micro, mb, ...]`` outputs, replicated across the axis.
+    """
+    n_micro = micro_inputs.shape[0]
+    stage = lax.axis_index(axis_name)
+    total_ticks = n_micro + n_stages - 1
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    act0 = jnp.zeros_like(micro_inputs[0])
+    outputs0 = jnp.zeros_like(micro_inputs)
+
+    def tick(carry, t):
+        in_flight, outputs = carry
+        # stage 0 ingests microbatch t while it exists; later stages consume
+        # what arrived over the ring
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        x = jnp.where(stage == 0,
+                      lax.dynamic_index_in_dim(micro_inputs, mb_idx, axis=0,
+                                               keepdims=False),
+                      in_flight)
+        y = stage_fn(stage_params, x)
+        # the last stage emits microbatch t-(n_stages-1) once the fill phase
+        # is over
+        out_idx = t - (n_stages - 1)
+        store = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+        upd = lax.dynamic_update_index_in_dim(
+            outputs, y.astype(outputs.dtype),
+            jnp.clip(out_idx, 0, n_micro - 1), axis=0)
+        outputs = jnp.where(store, upd, outputs)
+        # hop every stage's activation one stage forward (single ppermute)
+        in_flight = lax.ppermute(y, axis_name, fwd_perm)
+        return (in_flight, outputs), None
+
+    (_, outputs), _ = lax.scan(tick, (act0, outputs0),
+                               jnp.arange(total_ticks))
+    # results live on the last stage; replicate them
+    return broadcast_p(outputs, axis_name, root_rank=n_stages - 1)
+
+
+def split_microbatches(x, n_micro: int):
+    """[B, ...] -> [n_micro, B/n_micro, ...] (B must divide)."""
+    b = x.shape[0]
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible into {n_micro} microbatches")
+    return x.reshape(n_micro, b // n_micro, *x.shape[1:])
+
+
+def merge_microbatches(y):
+    """[n_micro, mb, ...] -> [n_micro*mb, ...]."""
+    return y.reshape(y.shape[0] * y.shape[1], *y.shape[2:])
