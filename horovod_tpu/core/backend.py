@@ -53,6 +53,21 @@ class Backend:
     def init(self):
         if self._initialized:
             return
+        platform = os.environ.get(env_mod.HOROVOD_TPU_PLATFORM)
+        if platform:
+            # test/override hook: the environment's sitecustomize pins the
+            # platform via jax.config, so an env var alone is read too late
+            jax.config.update("jax_platforms", platform)
+            got = jax.devices()[0].platform
+            want = platform.split(",")[0].strip().lower()
+            if got != want:
+                # a jax computation before hvd.init() already initialized
+                # the backend — the override silently wouldn't apply, which
+                # is exactly the wrong-platform trap this knob exists to fix
+                raise HorovodInternalError(
+                    f"HOROVOD_TPU_PLATFORM={platform!r} could not take "
+                    f"effect (backend already initialized on {got!r}); set "
+                    f"it before any jax computation runs")
         self._removed = False
         slot = None
         elastic = bool(os.environ.get(env_mod.HOROVOD_ELASTIC))

@@ -27,6 +27,7 @@ class GlobalState:
             if self.backend is not None and self.backend.initialized:
                 return
             self.config = env_mod.Config.from_env()
+            _apply_log_level()
             self.backend = Backend()
             self.backend.init()
             self.engine = Engine(self.backend, self.config)
@@ -113,6 +114,30 @@ class GlobalState:
     @property
     def initialized(self) -> bool:
         return self.backend is not None and self.backend.initialized
+
+
+def _apply_log_level():
+    """HOROVOD_LOG_LEVEL (reference logging.cc:76-93): trace/debug/info/
+    warning/error/fatal onto the framework logger."""
+    import logging
+    import os
+    level = os.environ.get(env_mod.HOROVOD_LOG_LEVEL)
+    if not level:
+        return
+    mapping = {"trace": logging.DEBUG, "debug": logging.DEBUG,
+               "info": logging.INFO, "warning": logging.WARNING,
+               "error": logging.ERROR, "fatal": logging.CRITICAL}
+    lvl = mapping.get(level.strip().lower())
+    if lvl is not None:
+        logger = logging.getLogger("horovod_tpu")
+        logger.setLevel(lvl)
+        # without a handler, DEBUG/INFO would be filtered by Python's
+        # lastResort handler (WARNING) and the knob would be a silent no-op
+        if not logger.handlers and not logging.getLogger().handlers:
+            h = logging.StreamHandler()
+            h.setFormatter(logging.Formatter(
+                "[%(asctime)s] %(levelname)s %(name)s: %(message)s"))
+            logger.addHandler(h)
 
 
 _global_state = GlobalState()

@@ -53,6 +53,7 @@ def launch_elastic_job(discovery, np: int, command: List[str],
                        ssh_port: Optional[int] = None,
                        identity_file: Optional[str] = None,
                        timeout: Optional[float] = None,
+                       network_interfaces: Optional[List[str]] = None,
                        verbose: bool = False) -> ElasticDriver:
     """Start the rendezvous + driver and run ``command`` elastically.
 
@@ -73,7 +74,8 @@ def launch_elastic_job(discovery, np: int, command: List[str],
         if is_local_host(slot.hostname):
             return "127.0.0.1"
         from ..runner.hosts import HostInfo
-        return _driver_ip([HostInfo(slot.hostname, 1)])
+        return _driver_ip([HostInfo(slot.hostname, 1)],
+                          network_interfaces)
 
     def _create_worker(slot: SlotInfo):
         env = make_elastic_worker_env(slot, _rdv_addr_for(slot), server.port,
@@ -120,12 +122,14 @@ def launch_elastic(args, command: List[str],
         print("tpurun: elastic mode needs --host-discovery-script or -H",
               file=sys.stderr)
         return 2
+    from ..runner.launch import _parse_interfaces
     try:
         launch_elastic_job(discovery, np, command, base_env,
                            min_np=args.min_np or np, max_np=args.max_np,
                            reset_limit=args.reset_limit,
                            ssh_port=args.ssh_port,
                            identity_file=args.ssh_identity_file,
+                           network_interfaces=_parse_interfaces(args),
                            verbose=args.verbose)
     except (RuntimeError, TimeoutError) as e:
         print(str(e), file=sys.stderr)

@@ -150,6 +150,16 @@ def launch_static(hosts: List[HostInfo], np: int, command: List[str],
             f"tpurun: {len(bad)} worker(s) exited non-zero: {bad}")
 
 
+def _parse_interfaces(args) -> Optional[List[str]]:
+    """--network-interfaces > HOROVOD_GLOO_IFACE (reference NIC pin knob);
+    whitespace-tolerant ("eth0, eth1")."""
+    iface_s = getattr(args, "network_interfaces", None) or \
+        os.environ.get(env_mod.HOROVOD_GLOO_IFACE)
+    if not iface_s:
+        return None
+    return [t.strip() for t in iface_s.split(",") if t.strip()] or None
+
+
 def _driver_ip(hosts: List[HostInfo],
                interfaces: Optional[List[str]] = None) -> str:
     if all(is_local_host(h.hostname) for h in hosts):
@@ -419,8 +429,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         hosts = parse_hosts(args.hosts)
     else:
         hosts = [HostInfo("localhost", args.num_proc)]
-    ifaces = (args.network_interfaces.split(",")
-              if args.network_interfaces else None)
+    ifaces = _parse_interfaces(args)
     if args.task_agents:
         key_hex = os.environ.get("HOROVOD_TASK_SECRET")
         if not key_hex:
