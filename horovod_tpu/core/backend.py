@@ -58,16 +58,28 @@ class Backend:
             # test/override hook: the environment's sitecustomize pins the
             # platform via jax.config, so an env var alone is read too late
             jax.config.update("jax_platforms", platform)
-            got = jax.devices()[0].platform
-            want = platform.split(",")[0].strip().lower()
-            if got != want:
-                # a jax computation before hvd.init() already initialized
-                # the backend — the override silently wouldn't apply, which
-                # is exactly the wrong-platform trap this knob exists to fix
-                raise HorovodInternalError(
-                    f"HOROVOD_TPU_PLATFORM={platform!r} could not take "
-                    f"effect (backend already initialized on {got!r}); set "
-                    f"it before any jax computation runs")
+            # Verify the override took effect — but only PASSIVELY: calling
+            # jax.devices() here would itself initialize the backend and
+            # break the jax.distributed.initialize below for multi-process
+            # jobs. If backends aren't initialized yet, the config update is
+            # guaranteed to apply.
+            already_initialized = False
+            try:
+                import jax._src.xla_bridge as _xb
+                already_initialized = bool(getattr(_xb, "_backends", None))
+            except Exception:
+                pass
+            if already_initialized and "," not in platform:
+                # single-platform pin (a comma list means fallback is
+                # intended, so any member platform is acceptable)
+                got = jax.devices()[0].platform
+                want = platform.strip().lower()
+                aliases = {"cuda": "gpu", "rocm": "gpu"}
+                if got != want and aliases.get(want, want) != got:
+                    raise HorovodInternalError(
+                        f"HOROVOD_TPU_PLATFORM={platform!r} could not take "
+                        f"effect (backend already initialized on {got!r}); "
+                        f"set it before any jax computation runs")
         self._removed = False
         slot = None
         elastic = bool(os.environ.get(env_mod.HOROVOD_ELASTIC))

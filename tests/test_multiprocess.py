@@ -74,3 +74,43 @@ def test_nonzero_exit_fails_job(tmp_path):
         launch_static([HostInfo("localhost", 2)], 2,
                       [sys.executable, "-c", "import sys; sys.exit(3)"],
                       dict(os.environ))
+
+
+def _worker_alltoall_rs():
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+    rank, size = hvd.rank(), hvd.size()
+    out = {}
+    # equal-split alltoall: rank r sends row "100*r + dest" to each dest
+    x = np.stack([np.full((2,), 100 * rank + d, np.float32)
+                  for d in range(size)])
+    out["alltoall"] = np.asarray(hvd.alltoall(x, name="at")).tolist()
+    # uneven splits: rank 0 sends 1 row to each, rank 1 sends 2 rows to each
+    rows = (rank + 1) * size
+    xs = np.full((rows, 1), float(rank), np.float32)
+    recv, counts = hvd.alltoall(xs, splits=[rank + 1] * size, name="atv")
+    out["recv_counts"] = [int(c) for c in np.asarray(counts)]
+    out["recv_rows"] = int(recv.shape[0])
+    # reducescatter
+    rs = np.asarray(hvd.reducescatter(
+        np.arange(size * 3, dtype=np.float32).reshape(size, 3), name="rs"))
+    out["rs"] = rs.tolist()
+    return out
+
+
+@pytest.mark.integration
+def test_two_process_alltoall_reducescatter():
+    from horovod_tpu.runner import run
+    r0, r1 = run(_worker_alltoall_rs, np=2, env=_mp_env())
+    # alltoall: rank 0 receives [own dest-0 chunk, rank1's dest-0 chunk]
+    assert r0["alltoall"] == [[0.0, 0.0], [100.0, 100.0]], r0
+    assert r1["alltoall"] == [[1.0, 1.0], [101.0, 101.0]], r1
+    # uneven: each rank receives 1 row from rank0 and 2 rows from rank1
+    for r in (r0, r1):
+        assert r["recv_counts"] == [1, 2], r
+        assert r["recv_rows"] == 3, r
+    # reducescatter of identical (2,3) tensors: row r summed → 2x values
+    assert r0["rs"] == [[0.0, 2.0, 4.0]], r0
+    assert r1["rs"] == [[6.0, 8.0, 10.0]], r1
