@@ -42,6 +42,55 @@ def _dumps_payload(fn, args, kwargs) -> bytes:
             cloudpickle.unregister_pickle_by_value(mod)
 
 
+import contextlib
+
+
+def _check_shared_fs(host_infos, env: Optional[Dict[str, str]]) -> None:
+    """The programmatic APIs move the pickled fn + results through a local
+    tempdir; remote hosts need that path on a shared filesystem."""
+    from .launch import is_local_host
+    remote = [h.hostname for h in host_infos if not is_local_host(h.hostname)]
+    ack = (env or {}).get("HOROVOD_TPU_SHARED_FS",
+                          os.environ.get("HOROVOD_TPU_SHARED_FS"))
+    if remote and ack != "1":
+        raise ValueError(
+            f"programmatic run with remote hosts {remote} passes the pickled "
+            "function and collects results through a temporary directory, "
+            "which must be on a filesystem shared by every host. Set "
+            "HOROVOD_TPU_SHARED_FS=1 to acknowledge, or use tpurun with a "
+            "script instead.")
+
+
+@contextlib.contextmanager
+def _worker_bootstrap(fn, args, kwargs, env: Optional[Dict[str, str]],
+                      use_current_interpreter: bool = True):
+    """Shared run()/run_elastic() plumbing: serialized payload in a tempdir,
+    the run_task command line, and the merged worker env."""
+    import sys
+    with tempfile.TemporaryDirectory(prefix="hvd_tpu_run_") as tmp:
+        payload = os.path.join(tmp, "payload.pkl")
+        with open(payload, "wb") as f:
+            f.write(_dumps_payload(fn, args, kwargs))
+        interpreter = sys.executable if use_current_interpreter else "python3"
+        command = [interpreter, "-m", "horovod_tpu.runner.run_task",
+                   payload, tmp]
+        base_env = dict(os.environ)
+        if env:
+            base_env.update(env)
+        yield tmp, command, base_env
+
+
+def _collect_results(tmp: str, expected: int) -> List[Any]:
+    results = []
+    for rank in range(expected):
+        path = os.path.join(tmp, f"result_{rank}.pkl")
+        if not os.path.exists(path):
+            raise RuntimeError(f"rank {rank} produced no result")
+        with open(path, "rb") as f:
+            results.append(pickle.load(f))
+    return results
+
+
 def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
         np: int = 1, hosts: Optional[str] = None,
         env: Optional[Dict[str, str]] = None,
@@ -54,36 +103,57 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
     """
     kwargs = kwargs or {}
     host_infos = parse_hosts(hosts) if hosts else [HostInfo("localhost", np)]
-    from .launch import is_local_host
-    remote = [h.hostname for h in host_infos if not is_local_host(h.hostname)]
-    if remote and os.environ.get("HOROVOD_TPU_SHARED_FS") != "1":
-        raise ValueError(
-            f"run() with remote hosts {remote} passes the pickled function "
-            "and collects results through a temporary directory, which must "
-            "be on a filesystem shared by every host. Set "
-            "HOROVOD_TPU_SHARED_FS=1 to acknowledge, or use tpurun with a "
-            "script instead.")
-
-    with tempfile.TemporaryDirectory(prefix="hvd_tpu_run_") as tmp:
-        payload = os.path.join(tmp, "payload.pkl")
-        with open(payload, "wb") as f:
-            f.write(_dumps_payload(fn, args, kwargs))
-        import sys
-        interpreter = sys.executable if use_current_interpreter else "python3"
-        command = [interpreter, "-m", "horovod_tpu.runner.run_task",
-                   payload, tmp]
-        base_env = dict(os.environ)
-        if env:
-            base_env.update(env)
+    _check_shared_fs(host_infos, env)
+    with _worker_bootstrap(fn, args, kwargs, env,
+                           use_current_interpreter) as (tmp, command,
+                                                        base_env):
         launch_static(host_infos, np, command, base_env, verbose=verbose)
-        results = []
-        for rank in range(np):
-            path = os.path.join(tmp, f"result_{rank}.pkl")
-            if not os.path.exists(path):
-                raise RuntimeError(f"rank {rank} produced no result")
-            with open(path, "rb") as f:
-                results.append(pickle.load(f))
-        return results
+        return _collect_results(tmp, np)
 
 
-__all__ = ["run", "launch_static", "HostInfo", "parse_hosts"]
+def run_elastic(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
+                np: int = 2, min_np: Optional[int] = None,
+                max_np: Optional[int] = None,
+                discovery=None, discovery_script: Optional[str] = None,
+                hosts: Optional[str] = None,
+                env: Optional[Dict[str, str]] = None,
+                reset_limit: Optional[int] = None,
+                timeout: Optional[float] = None,
+                verbose: bool = False) -> List[Any]:
+    """Elastic counterpart of :func:`run` (parity:
+    ``horovod.spark.run_elastic``, reference spark/runner.py:303, over the
+    gloo-elastic flow of launch.py:574).
+
+    ``fn`` runs on every worker under the elastic runtime; wrap its training
+    loop with ``@hvd.elastic.run`` + a committed state to survive membership
+    changes. Membership comes from ``discovery`` (a HostDiscovery), a
+    ``discovery_script`` (path whose stdout lists ``host:slots``), or a
+    static ``hosts`` string. Returns the final world's results in rank
+    order; workers scaled out mid-run are excluded.
+    """
+    kwargs = kwargs or {}
+    from ..elastic.discovery import FixedHosts, HostDiscoveryScript
+    from ..elastic.launcher import launch_elastic_job
+    if discovery is None:
+        if discovery_script:
+            discovery = HostDiscoveryScript(discovery_script)
+        elif hosts:
+            host_infos = parse_hosts(hosts)
+            _check_shared_fs(host_infos, env)
+            discovery = FixedHosts({h.hostname: h.slots
+                                    for h in host_infos})
+        else:
+            discovery = FixedHosts({"localhost": max_np or np})
+    with _worker_bootstrap(fn, args, kwargs, env) as (tmp, command,
+                                                      base_env):
+        driver = launch_elastic_job(discovery, np, command,
+                                    base_env=base_env,
+                                    min_np=min_np or np, max_np=max_np,
+                                    reset_limit=reset_limit, timeout=timeout,
+                                    verbose=verbose)
+        # validate against the FINAL world size (a truncated scan would
+        # silently return partial results)
+        return _collect_results(tmp, driver.world_size())
+
+
+__all__ = ["run", "run_elastic", "launch_static", "HostInfo", "parse_hosts"]

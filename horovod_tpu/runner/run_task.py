@@ -29,6 +29,12 @@ def main(payload_path: str, out_dir: str) -> int:
     hvd.init()
     try:
         result = fn(*args, **kwargs)
+        from horovod_tpu.core.state import global_state
+        backend = global_state().backend
+        if backend is not None and getattr(backend, "removed", False):
+            # elastically scaled out: this worker's inert backend reports
+            # rank 0 — writing result_0 would collide with the real rank 0
+            return 0
         rank = hvd.rank()
         with open(os.path.join(out_dir, f"result_{rank}.pkl"), "wb") as f:
             pickle.dump(result, f)

@@ -114,3 +114,36 @@ def test_two_process_alltoall_reducescatter():
     # reducescatter of identical (2,3) tensors: row r summed → 2x values
     assert r0["rs"] == [[0.0, 2.0, 4.0]], r0
     assert r1["rs"] == [[6.0, 8.0, 10.0]], r1
+
+
+def _elastic_fn(total):
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+
+    state = hvd.elastic.ObjectState(batch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.batch < total:
+            out = np.asarray(hvd.allreduce(np.ones(2), name=f"b{state.batch}",
+                                           op=hvd.Sum))
+            assert out[0] == hvd.size()
+            state.batch += 1
+            state.commit()
+        return {"rank": hvd.rank(), "size": hvd.size(), "batch": state.batch}
+
+    return train(state)
+
+
+@pytest.mark.integration
+def test_run_elastic_programmatic():
+    """Programmatic elastic API (reference spark run_elastic parity): the
+    function runs under the elastic runtime and per-final-rank results come
+    back in order."""
+    from horovod_tpu.runner import run_elastic
+    results = run_elastic(_elastic_fn, args=(10,), np=2, max_np=2,
+                          env=_mp_env(), timeout=120)
+    assert results == [{"rank": 0, "size": 2, "batch": 10},
+                       {"rank": 1, "size": 2, "batch": 10}], results
