@@ -28,7 +28,9 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..parallel.moe import MoEParams, moe_layer_p
 from ..parallel.ring_attention import ring_attention_p, local_attention
+from ..parallel.ulysses import ulysses_attention_p
 
 DATA_AXIS = "data"
 SEQ_AXIS = "seq"
@@ -44,6 +46,15 @@ class TransformerConfig:
     d_ff: int = 2048
     max_seq: int = 2048
     dtype: Any = jnp.bfloat16
+    # sequence-parallel attention kernel: "ring" (ppermute K/V rotation) or
+    # "ulysses" (head/sequence all-to-all); identical numerics, different
+    # communication patterns (parallel/ulysses.py docstring)
+    attention: str = "ring"
+    # MoE FFN (expert parallelism): experts sharded over the tensor axis
+    use_moe: bool = False
+    n_experts: int = 8
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -61,7 +72,8 @@ def init_params(key, cfg: TransformerConfig):
     def norm_init(k, shape, fan_in):
         return jax.random.normal(k, shape, jnp.float32) * (fan_in ** -0.5)
 
-    ks = jax.random.split(k_layers, 6 * L).reshape(L, 6, 2)
+    n_keys = 7 if cfg.use_moe else 6   # dense init stays seed-compatible
+    ks = jax.random.split(k_layers, n_keys * L).reshape(L, n_keys, 2)
     layers = {
         "ln1": jnp.ones((L, D), jnp.float32),
         "wq": jnp.stack([norm_init(ks[i, 0], (D, H, Dh), D) for i in range(L)]),
@@ -69,9 +81,26 @@ def init_params(key, cfg: TransformerConfig):
         "wv": jnp.stack([norm_init(ks[i, 2], (D, H, Dh), D) for i in range(L)]),
         "wo": jnp.stack([norm_init(ks[i, 3], (H, Dh, D), D) for i in range(L)]),
         "ln2": jnp.ones((L, D), jnp.float32),
-        "w1": jnp.stack([norm_init(ks[i, 4], (D, F), D) for i in range(L)]),
-        "w2": jnp.stack([norm_init(ks[i, 5], (F, D), F) for i in range(L)]),
     }
+    if cfg.use_moe:
+        E = cfg.n_experts
+        layers.update({
+            "router": jnp.stack([norm_init(ks[i, 6], (D, E), D) * 0.1
+                                 for i in range(L)]),
+            "w1": jnp.stack([jnp.stack([norm_init(
+                jax.random.fold_in(ks[i, 4], e), (D, F), D)
+                for e in range(E)]) for i in range(L)]),   # [L, E, D, F]
+            "w2": jnp.stack([jnp.stack([norm_init(
+                jax.random.fold_in(ks[i, 5], e), (F, D), F)
+                for e in range(E)]) for i in range(L)]),   # [L, E, F, D]
+        })
+    else:
+        layers.update({
+            "w1": jnp.stack([norm_init(ks[i, 4], (D, F), D)
+                             for i in range(L)]),
+            "w2": jnp.stack([norm_init(ks[i, 5], (F, D), F)
+                             for i in range(L)]),
+        })
     return {
         "embed": norm_init(k_embed, (cfg.vocab_size, D), D) * (D ** 0.5) * 0.02,
         "layers": layers,
@@ -82,16 +111,21 @@ def init_params(key, cfg: TransformerConfig):
 def param_specs(cfg: TransformerConfig):
     """PartitionSpecs over (data, seq, tensor): heads/hidden sharded on tensor,
     everything replicated over data+seq (their reduction happens in backward)."""
-    return {
-        "embed": P(),
-        "layers": {
-            "ln1": P(), "ln2": P(),
-            "wq": P(None, None, TENSOR_AXIS), "wk": P(None, None, TENSOR_AXIS),
-            "wv": P(None, None, TENSOR_AXIS), "wo": P(None, TENSOR_AXIS),
-            "w1": P(None, None, TENSOR_AXIS), "w2": P(None, TENSOR_AXIS),
-        },
-        "ln_f": P(),
+    layers = {
+        "ln1": P(), "ln2": P(),
+        "wq": P(None, None, TENSOR_AXIS), "wk": P(None, None, TENSOR_AXIS),
+        "wv": P(None, None, TENSOR_AXIS), "wo": P(None, TENSOR_AXIS),
     }
+    if cfg.use_moe:
+        # experts sharded over the tensor axis (EP replaces TP for the FFN);
+        # the router stays replicated
+        layers.update({"router": P(),
+                       "w1": P(None, TENSOR_AXIS),
+                       "w2": P(None, TENSOR_AXIS)})
+    else:
+        layers.update({"w1": P(None, None, TENSOR_AXIS),
+                       "w2": P(None, TENSOR_AXIS)})
+    return {"embed": P(), "layers": layers, "ln_f": P()}
 
 
 def _rmsnorm(x, scale):
@@ -100,10 +134,11 @@ def _rmsnorm(x, scale):
     return (x32 * jax.lax.rsqrt(var + 1e-6) * scale).astype(x.dtype)
 
 
-def forward_block(params, tokens, cfg: TransformerConfig,
-                  seq_size: Optional[int] = None,
-                  tensor_size: Optional[int] = None, causal: bool = True):
-    """Forward over a *local* token block [B_local, T_local].
+def _forward(params, tokens, cfg: TransformerConfig,
+             seq_size: Optional[int] = None,
+             tensor_size: Optional[int] = None, causal: bool = True):
+    """Forward over a *local* token block [B_local, T_local]; returns
+    (logits, moe_aux_loss) — aux is 0 for the dense FFN.
 
     ``seq_size``/``tensor_size`` are the mesh-axis sizes when running inside
     shard_map (collectives are emitted whenever the axis is manual, even at
@@ -112,42 +147,98 @@ def forward_block(params, tokens, cfg: TransformerConfig,
     """
     dt = cfg.dtype
     h = params["embed"][tokens].astype(dt)  # [B, T, D]
-    Dh = cfg.head_dim
 
-    def layer(h, lp):
+    def layer(carry, lp):
+        h, aux_sum = carry
         # Attention
         x = _rmsnorm(h, lp["ln1"])
         q = jnp.einsum("btd,dhk->bthk", x, lp["wq"].astype(dt))
         k = jnp.einsum("btd,dhk->bthk", x, lp["wk"].astype(dt))
         v = jnp.einsum("btd,dhk->bthk", x, lp["wv"].astype(dt))
         if seq_size is not None and seq_size > 1:
-            att = ring_attention_p(q, k, v, SEQ_AXIS, seq_size, causal=causal)
+            attn_p = (ulysses_attention_p if cfg.attention == "ulysses"
+                      else ring_attention_p)
+            att = attn_p(q, k, v, SEQ_AXIS, seq_size, causal=causal)
         else:
             att = local_attention(q, k, v, causal=causal)
         out = jnp.einsum("bthk,hkd->btd", att, lp["wo"].astype(dt))
         if tensor_size is not None:
             out = lax.psum(out, TENSOR_AXIS)
         h = h + out
-        # MLP
+        # FFN: dense (TP over hidden dim) or MoE (EP over the same axis)
         x = _rmsnorm(h, lp["ln2"])
-        u = jax.nn.gelu(jnp.einsum("btd,df->btf", x, lp["w1"].astype(dt)))
-        out = jnp.einsum("btf,fd->btd", u, lp["w2"].astype(dt))
-        if tensor_size is not None:
-            out = lax.psum(out, TENSOR_AXIS)
+        if cfg.use_moe:
+            b, t, d = x.shape
+            mp = MoEParams(lp["router"], lp["w1"], lp["w2"])
+            tok = x.reshape(b * t, d)
+            if tensor_size is not None and tensor_size > 1:
+                # EP over the tensor axis: split this shard's tokens across
+                # the axis members (no duplicate expert compute), dispatch,
+                # and gather the processed tokens back
+                n = tensor_size
+                pad = (-tok.shape[0]) % n
+                n_tok = tok.shape[0]
+                if pad:
+                    tok = jnp.concatenate(
+                        [tok, jnp.zeros((pad, d), tok.dtype)])
+                per = tok.shape[0] // n
+                idx = lax.axis_index(TENSOR_AXIS)
+                mine = lax.dynamic_slice_in_dim(tok, idx * per, per)
+                # mask out pad rows: they must not route, take capacity,
+                # or skew the aux statistics
+                rows = idx * per + jnp.arange(per)
+                y_mine, aux = moe_layer_p(
+                    mine, mp, TENSOR_AXIS, n,
+                    capacity_factor=cfg.moe_capacity_factor,
+                    valid_mask=rows < n_tok)
+                y2d = lax.all_gather(y_mine, TENSOR_AXIS, axis=0, tiled=True)
+                if pad:
+                    y2d = y2d[:-pad]
+            else:
+                y2d, aux = moe_layer_p(
+                    tok, mp, TENSOR_AXIS, 1,
+                    capacity_factor=cfg.moe_capacity_factor)
+            out = y2d.reshape(b, t, d)
+            aux_sum = aux_sum + aux
+        else:
+            u = jax.nn.gelu(jnp.einsum("btd,df->btf", x,
+                                       lp["w1"].astype(dt)))
+            out = jnp.einsum("btf,fd->btd", u, lp["w2"].astype(dt))
+            if tensor_size is not None:
+                out = lax.psum(out, TENSOR_AXIS)
         h = h + out
-        return h, None
+        return (h, aux_sum), None
 
-    h, _ = lax.scan(layer, h, params["layers"])
+    aux0 = jnp.zeros((), jnp.float32)
+    if cfg.use_moe and tensor_size is not None:
+        # MoE outputs travel through all-to-all/all-gather over the tensor
+        # axis, so the carry is (formally) varying over it — align the
+        # initial carry's varying-manual-axes type
+        h = lax.pcast(h, (TENSOR_AXIS,), to="varying")
+        # aux derives from tokens (varying over data+seq) and the dispatch
+        # (varying over tensor)
+        aux0 = lax.pcast(aux0, (DATA_AXIS, SEQ_AXIS, TENSOR_AXIS),
+                         to="varying")
+    (h, aux_sum), _ = lax.scan(layer, (h, aux0), params["layers"])
     h = _rmsnorm(h, params["ln_f"])
     logits = jnp.einsum("btd,vd->btv", h, params["embed"].astype(dt))
-    return logits.astype(jnp.float32)
+    return logits.astype(jnp.float32), aux_sum / cfg.n_layers
+
+
+def forward_block(params, tokens, cfg: TransformerConfig,
+                  seq_size: Optional[int] = None,
+                  tensor_size: Optional[int] = None, causal: bool = True):
+    """Logits-only wrapper (the driver's ``entry()`` compile-check target and
+    the dense-model public API)."""
+    logits, _ = _forward(params, tokens, cfg, seq_size, tensor_size, causal)
+    return logits
 
 
 def _local_loss(params, inputs, targets, cfg, seq_size=None, tensor_size=None):
-    logits = forward_block(params, inputs, cfg, seq_size, tensor_size)
+    logits, aux = _forward(params, inputs, cfg, seq_size, tensor_size)
     logp = jax.nn.log_softmax(logits)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.sum(nll), nll.size
+    return jnp.sum(nll), nll.size, aux
 
 
 def make_spmd_loss(mesh: Mesh, cfg: TransformerConfig):
@@ -161,13 +252,18 @@ def make_spmd_loss(mesh: Mesh, cfg: TransformerConfig):
     tok_spec = P(DATA_AXIS, SEQ_AXIS)
 
     def body(params, inputs, targets):
-        total, count = _local_loss(params, inputs, targets, cfg, s_size, t_size)
+        total, count, aux = _local_loss(params, inputs, targets, cfg,
+                                        s_size, t_size)
         # Mean over all tokens: psum across batch+sequence shards. (The
         # backward pass of this psum + the replicated params realizes the
         # gradient allreduce the reference does explicitly.)
         total = lax.psum(total, (DATA_AXIS, SEQ_AXIS))
         n = count * d_size * s_size
         loss = total / n
+        if cfg.use_moe:
+            # aux is computed on local tokens; average across shards
+            loss = loss + cfg.moe_aux_weight * lax.pmean(
+                aux, (DATA_AXIS, SEQ_AXIS))
         # tensor axis computes identical values; make that explicit for out_specs
         return lax.pmean(loss, TENSOR_AXIS)
 

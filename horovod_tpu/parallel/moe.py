@@ -47,13 +47,21 @@ def init_moe(key, d_model: int, d_ff: int, n_experts: int,
 
 
 def moe_layer_p(x, params: MoEParams, axis_name: str, axis_size: int,
-                capacity_factor: float = 1.25) -> Tuple[jax.Array, jax.Array]:
+                capacity_factor: float = 1.25,
+                valid_mask=None) -> Tuple[jax.Array, jax.Array]:
     """Top-1 MoE over ``axis_name`` (size may be 1 = no EP).
+
+    Capacity and the aux loss are **per dispatch group** (this call's ``x``
+    plus its axis peers) — the standard Switch/GShard semantics; global-batch
+    statistics would need the caller to psum across its other mesh axes.
 
     Args:
       x: local tokens ``[T, d_model]`` (flatten batch×seq first).
       params: this shard's :class:`MoEParams` (experts sharded over the
         axis; router replicated).
+      valid_mask: optional ``[T]`` bool — False rows (e.g. padding) are
+        excluded from routing statistics, consume no expert capacity, and
+        produce zero output.
 
     Returns ``(y, aux_loss)``: y ``[T, d_model]`` (zeros for dropped
     tokens — add the residual outside), and the scalar load-balance loss.
@@ -69,15 +77,25 @@ def moe_layer_p(x, params: MoEParams, axis_name: str, axis_size: int,
     expert = jnp.argmax(probs, axis=-1)                  # [T]
     gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
 
-    # Switch aux loss: E · Σ_e (fraction of tokens on e)·(mean prob of e)
-    onehot = jax.nn.one_hot(expert, e_total, dtype=jnp.float32)
-    aux = e_total * jnp.sum(jnp.mean(onehot, axis=0) *
-                            jnp.mean(probs, axis=0))
+    if valid_mask is None:
+        valid = jnp.ones((t,), jnp.float32)
+    else:
+        valid = valid_mask.astype(jnp.float32)
+    n_valid = jnp.maximum(jnp.sum(valid), 1.0)
+
+    # Switch aux loss: E · Σ_e (fraction of tokens on e)·(mean prob of e),
+    # over VALID tokens only (pad rows would otherwise skew both factors)
+    onehot = jax.nn.one_hot(expert, e_total, dtype=jnp.float32) * valid[:, None]
+    aux = e_total * jnp.sum(
+        (jnp.sum(onehot, axis=0) / n_valid) *
+        (jnp.sum(probs * valid[:, None], axis=0) / n_valid))
 
     # capacity slotting: position of each token in its expert's queue
+    # (invalid tokens take no slot)
     pos_in_expert = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot,
                             axis=-1).astype(jnp.int32) - 1     # [T]
-    keep = pos_in_expert < capacity
+    keep = jnp.logical_and(pos_in_expert < capacity,
+                           pos_in_expert >= 0)
     slot = jnp.where(keep, pos_in_expert, capacity - 1)
 
     # dispatch buffer [E, C, d]; dropped tokens masked to zero contributions
