@@ -91,6 +91,82 @@ def _time_steps(fn, state, const_args, iters):
     return max(dt, 1e-9) / iters, rtt
 
 
+def bench_transformer():
+    """Flagship transformer-LM MFU (decoder LM, bf16, flash attention, lean
+    logsumexp loss). Timed as the marginal cost of extra scan steps inside
+    one jitted program (steps are dependent through the carried params, so
+    nothing can be elided or overlapped away), which excludes the tunnel's
+    per-dispatch overhead. MFU uses the analytic model-FLOPs convention
+    (6·N·tokens + causal attention counted at half the full T² matmul —
+    remat/recompute would not count, though this config uses none).
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from functools import partial
+    from jax import lax
+
+    from horovod_tpu.models.transformer import (TransformerConfig,
+                                                init_params, lean_lm_loss)
+
+    cfg = TransformerConfig(
+        vocab_size=32768, d_model=2048, n_heads=16,
+        n_layers=int(os.environ.get("BENCH_LM_LAYERS", "4")),
+        d_ff=8192, max_seq=2048, dtype=jnp.bfloat16, attention="flash")
+    B = int(os.environ.get("BENCH_LM_BATCH", "4"))
+    T = cfg.max_seq
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    opt = optax.sgd(0.01, momentum=0.9)
+
+    def step(carry, _):
+        p, o = carry
+        tok = jnp.zeros((B, T), jnp.int32)
+        tgt = jnp.zeros((B, T), jnp.int32)
+        loss, g = jax.value_and_grad(lean_lm_loss)(p, tok, tgt, cfg)
+        u, o = opt.update(g, o, p)
+        return (optax.apply_updates(p, u), o), loss
+
+    @partial(jax.jit, static_argnums=0)
+    def run(iters, st):
+        st, ls = lax.scan(step, st, None, length=iters)
+        return st, ls[-1]
+
+    st0 = (params, opt.init(params))
+    i1, i2 = 2, 6
+    for it in (i1, i2):
+        _, loss = run(it, st0)
+        _fetch_scalar(loss)
+    t0 = time.perf_counter()
+    _fetch_scalar(run(i1, st0)[1])
+    d1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    _fetch_scalar(run(i2, st0)[1])
+    d2 = time.perf_counter() - t0
+    dt = max((d2 - d1) / (i2 - i1), 1e-9)
+
+    import jax.tree_util as jtu
+    n_params = sum(int(np.prod(v.shape)) for v in jtu.tree_leaves(params))
+    tokens = B * T
+    # causal attention: half of the full 4·B·T²·D matmul flops, x3 for train
+    attn_flops = cfg.n_layers * 4 * B * T * T * cfg.d_model * 3 // 2
+    model_flops = 6 * n_params * tokens + attn_flops
+    peak = _chip_peak_tflops(jax.devices()[0])
+    tflops = model_flops / dt / 1e12
+    return {
+        "transformer_step_time_ms": round(dt * 1e3, 3),
+        "transformer_tokens_per_sec": round(tokens / dt, 1),
+        "transformer_params_m": round(n_params / 1e6, 1),
+        "transformer_model_tflops_per_step": round(model_flops / 1e12, 3),
+        "transformer_achieved_tflops": round(tflops, 2),
+        "transformer_mfu_pct": (round(100.0 * tflops / peak, 2)
+                                if peak else None),
+        "transformer_config": (f"d{cfg.d_model}xL{cfg.n_layers}x"
+                               f"ff{cfg.d_ff} V{cfg.vocab_size} "
+                               f"B{B} T{T} flash"),
+    }
+
+
 def main():
     import numpy as np
     import jax
@@ -223,6 +299,14 @@ def main():
     tflops_chip = flops_per_chip / spmd_dt / 1e12
     peak = _chip_peak_tflops(jax.devices()[0])
     img_s_chip = spmd_img_s / n_chips
+
+    # flagship transformer-LM MFU (the MXU-dense workload; docs/roofline.md
+    # explains why the ResNet number is HBM-bound on v5e)
+    try:
+        lm = bench_transformer()
+    except Exception as e:  # keep the headline metric robust
+        lm = {"transformer_error": f"{type(e).__name__}: {e}"}
+
     print(json.dumps({
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
         "value": round(img_s_chip, 2),
@@ -240,6 +324,7 @@ def main():
                     if peak else None),
         "tunnel_rtt_ms": round(rtt * 1e3, 2),
         "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
+        **lm,
     }))
     hvd.shutdown()
 

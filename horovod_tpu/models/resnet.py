@@ -15,6 +15,8 @@ from typing import Any, Callable, Sequence, Tuple
 import jax.numpy as jnp
 import flax.linen as nn
 
+from ..ops.fused_batch_norm import FusedBatchNorm
+
 ModuleDef = Any
 
 
@@ -47,13 +49,25 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    # Opt-in Pallas fused-BN path. Measured on v5e: the standalone kernels
+    # run at full HBM bandwidth (~1 TB/s), but XLA already *fuses* the BN
+    # stat reductions into adjacent elementwise passes, so extracting them
+    # adds a memory pass and loses (~110ms -> ~184ms/step at batch 256).
+    # Kept for workloads where the stats are not fusion-adjacent (e.g.
+    # SyncBatchNorm local stats). Full analysis: docs/roofline.md.
+    fused_bn: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
                        param_dtype=jnp.float32)
-        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
-                       epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32)
+        if self.fused_bn:
+            norm = partial(FusedBatchNorm, use_running_average=not train,
+                           momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        else:
+            norm = partial(nn.BatchNorm, use_running_average=not train,
+                           momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                           param_dtype=jnp.float32)
         x = x.astype(self.dtype)
         x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
                  name="conv_init")(x)

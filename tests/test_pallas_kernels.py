@@ -52,3 +52,107 @@ def test_pack_pallas_matches_concat():
     got = np.asarray(pack_pallas(ts))
     want = np.concatenate([np.asarray(t).ravel() for t in ts])
     np.testing.assert_array_equal(got, want)
+
+
+# -- fused BatchNorm kernels + module (docs/roofline.md) --------------------
+
+
+@pytest.mark.parametrize("m,c", [(1000, 256), (1000, 64), (512, 128),
+                                 (777, 384)])
+def test_bn_stats_matches_numpy(m, c):
+    from horovod_tpu.ops.pallas_kernels import bn_stats_pallas
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(m, c), "bfloat16")
+    s, q = bn_stats_pallas(x)
+    xf = np.asarray(x, np.float32)
+    np.testing.assert_allclose(np.asarray(s), xf.sum(0), rtol=2e-2, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(q), (xf * xf).sum(0), rtol=2e-2,
+                               atol=1e-2)
+
+
+def test_bn_bwd_stats_matches_numpy():
+    from horovod_tpu.ops.pallas_kernels import bn_bwd_stats_pallas
+    rng = np.random.RandomState(1)
+    m, c = 900, 256
+    x = jnp.asarray(rng.randn(m, c), "bfloat16")
+    dy = jnp.asarray(rng.randn(m, c), "bfloat16")
+    xf, dyf = np.asarray(x, np.float32), np.asarray(dy, np.float32)
+    mean = jnp.asarray(xf.mean(0))
+    invstd = jnp.asarray(1.0 / (xf.std(0) + 1e-5))
+    s1, s2 = bn_bwd_stats_pallas(dy, x, mean, invstd)
+    xh = (xf - np.asarray(mean)) * np.asarray(invstd)
+    np.testing.assert_allclose(np.asarray(s1), dyf.sum(0), rtol=2e-2,
+                               atol=1e-1)
+    np.testing.assert_allclose(np.asarray(s2), (dyf * xh).sum(0), rtol=3e-2,
+                               atol=2e-1)
+
+
+def test_fused_batch_norm_matches_flax():
+    """FusedBatchNorm must match nn.BatchNorm: outputs, all three gradients,
+    running-stat EMA, and eval mode (fp32 so the comparison is tight)."""
+    import jax
+    import flax.linen as nn
+    from horovod_tpu.ops.fused_batch_norm import FusedBatchNorm
+
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 5, 5, 12), jnp.float32)
+    ref = nn.BatchNorm(use_running_average=False, momentum=0.9, epsilon=1e-5,
+                       dtype=jnp.float32, param_dtype=jnp.float32)
+    fus = FusedBatchNorm(use_running_average=False, momentum=0.9,
+                         epsilon=1e-5, dtype=jnp.float32)
+    vr = ref.init(jax.random.PRNGKey(0), x)
+    vf = fus.init(jax.random.PRNGKey(0), x)
+
+    def run(mod, p, bs, x):
+        y, mut = mod.apply({"params": p, "batch_stats": bs}, x,
+                           mutable=["batch_stats"])
+        return y, mut["batch_stats"]
+
+    yr, bsr = run(ref, vr["params"], vr["batch_stats"], x)
+    yf, bsf = run(fus, vr["params"], vf["batch_stats"], x)
+    np.testing.assert_allclose(np.asarray(yr), np.asarray(yf), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(bsr["mean"]),
+                               np.asarray(bsf["mean"]), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(bsr["var"]),
+                               np.asarray(bsf["var"]), atol=1e-5)
+
+    def loss(mod, v0, p, x):
+        return jnp.sum(jnp.sin(run(mod, p, v0["batch_stats"], x)[0]))
+
+    gr = jax.grad(lambda p: loss(ref, vr, p, x))(vr["params"])
+    gf = jax.grad(lambda p: loss(fus, vf, p, x))(vr["params"])
+    np.testing.assert_allclose(np.asarray(gr["scale"]),
+                               np.asarray(gf["scale"]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gr["bias"]),
+                               np.asarray(gf["bias"]), atol=1e-4)
+    gxr = jax.grad(lambda x: loss(ref, vr, vr["params"], x))(x)
+    gxf = jax.grad(lambda x: loss(fus, vf, vr["params"], x))(x)
+    np.testing.assert_allclose(np.asarray(gxr), np.asarray(gxf), atol=1e-4)
+
+    refe = nn.BatchNorm(use_running_average=True, momentum=0.9, epsilon=1e-5,
+                        dtype=jnp.float32, param_dtype=jnp.float32)
+    fuse = FusedBatchNorm(use_running_average=True, momentum=0.9,
+                          epsilon=1e-5, dtype=jnp.float32)
+    ye = refe.apply({"params": vr["params"], "batch_stats": bsr}, x)
+    yfe = fuse.apply({"params": vr["params"], "batch_stats": bsf}, x)
+    np.testing.assert_allclose(np.asarray(ye), np.asarray(yfe), atol=1e-5)
+
+
+def test_resnet_fused_bn_variant_trains():
+    """ResNet(fused_bn=True) runs fwd+bwd on the CPU world (XLA fallback of
+    the same custom_vjp path the TPU kernels use)."""
+    import jax
+    import optax
+    from horovod_tpu.models.resnet import ResNet18ish
+
+    m = ResNet18ish(num_classes=10, dtype=jnp.float32, fused_bn=True)
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 32, 32, 3), jnp.float32)
+    v = m.init(jax.random.PRNGKey(0), x, train=True)
+
+    def loss(p):
+        logits, _ = m.apply({"params": p, "batch_stats": v["batch_stats"]},
+                            x, train=True, mutable=["batch_stats"])
+        return jnp.mean(logits ** 2)
+
+    g = jax.grad(loss)(v["params"])
+    assert all(np.isfinite(np.asarray(leaf)).all()
+               for leaf in jax.tree_util.tree_leaves(g))

@@ -164,3 +164,32 @@ def test_moe_pad_tokens_do_not_skew_results():
         params, jax.device_put(jnp.asarray(inputs), tok_sh),
         jax.device_put(jnp.asarray(targets), tok_sh)))
     np.testing.assert_allclose(loss, ref, rtol=5e-4)
+
+
+def test_flash_attention_fallback_and_lean_loss():
+    """attention="flash" falls back to the materialized kernel off-TPU, and
+    lean_lm_loss matches the log_softmax formulation (fp32 config)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from horovod_tpu.models.transformer import (TransformerConfig,
+                                                init_params, _local_loss,
+                                                lean_lm_loss)
+
+    cfg = TransformerConfig(vocab_size=128, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_seq=16, dtype=jnp.float32,
+                            attention="flash")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tok = jnp.asarray(np.random.RandomState(0).randint(0, 128, (2, 16)))
+    tgt = jnp.asarray(np.random.RandomState(1).randint(0, 128, (2, 16)))
+    lean = float(lean_lm_loss(params, tok, tgt, cfg))
+    total, count, _ = _local_loss(params, tok, tgt, cfg)
+    ref = float(total) / count
+    assert abs(lean - ref) < 1e-4, (lean, ref)
+
+    # flash config == default config numerics on the fallback path
+    cfg_ref = TransformerConfig(vocab_size=128, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_seq=16,
+                                dtype=jnp.float32)
+    total2, _, _ = _local_loss(params, tok, tgt, cfg_ref)
+    assert abs(float(total) - float(total2)) < 1e-5

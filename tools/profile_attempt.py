@@ -1,0 +1,64 @@
+"""Background attempt to capture a device trace of the ResNet step.
+
+The axon tunnel may take minutes to set up profiling; run detached.
+Output: /tmp/rn_trace (xplane + perfetto trace if successful).
+"""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+sys.path.insert(0, "/root/repo")
+from horovod_tpu.models.resnet import ResNet50  # noqa: E402
+
+
+def fetch(x):
+    return float(np.asarray(x).reshape(-1)[0])
+
+
+def main():
+    batch = 256
+    m = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    v = m.init(jax.random.PRNGKey(0), jnp.ones((2, 224, 224, 3)), train=True)
+    params, bstats = v["params"], v["batch_stats"]
+    opt = optax.sgd(0.01, momentum=0.9)
+
+    def loss_fn(p, b, im, lb):
+        logits, mut = m.apply({"params": p, "batch_stats": b}, im, train=True,
+                              mutable=["batch_stats"])
+        logp = jax.nn.log_softmax(logits)
+        return (-jnp.mean(jnp.take_along_axis(logp, lb[:, None], axis=1)),
+                mut["batch_stats"])
+
+    @jax.jit
+    def step(p, b, o, im, lb):
+        (l, nb), g = jax.value_and_grad(loss_fn, has_aux=True)(p, b, im, lb)
+        u, o = opt.update(g, o, p)
+        p = optax.apply_updates(p, u)
+        return p, nb, o, l
+
+    im = jnp.asarray(np.random.RandomState(0).rand(batch, 224, 224, 3),
+                     jnp.float32)
+    lb = jnp.zeros((batch,), jnp.int32)
+    state = (params, bstats, opt.init(params))
+    out = step(*state, im, lb)
+    fetch(out[-1])
+    out = step(*out[:-1], im, lb)
+    fetch(out[-1])
+    state = out[:-1]
+    print("warmed up, starting trace", flush=True)
+    jax.profiler.start_trace("/tmp/rn_trace")
+    for _ in range(3):
+        out = step(*state, im, lb)
+        state = out[:-1]
+    fetch(out[-1])
+    print("steps done, stopping trace", flush=True)
+    jax.profiler.stop_trace()
+    print("trace complete", flush=True)
+
+
+if __name__ == "__main__":
+    main()
