@@ -199,6 +199,16 @@ _DTYPE_CODES = {"float32": 1, "float64": 2, "float16": 3, "bfloat16": 4,
 _CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
 _JOIN_META_DIMS = 7
 _JOIN_META_LEN = 3 + _JOIN_META_DIMS  # [op_or_root, dtype, ndim, d0..d6]
+# Metadata rows carried inline in the fixed-shape join round (the round's
+# shape must be identical on every rank *including ranks sitting in join()
+# that cannot know k in advance*, so it is padded to a fixed slot count;
+# ops with more tensors spill into one overflow exchange whose size both
+# sides derive deterministically from the head). 16 slots keep the head at
+# ~1.3 KB — single-tensor ops dominate, and large grouped calls pay one
+# extra (still async) overflow dispatch.
+_JOIN_META_SLOTS = int(__import__("os").environ.get(
+    "HOROVOD_JOIN_META_SLOTS", "16"))
+_JOIN_HEAD_LEN = 4 + _JOIN_META_SLOTS * _JOIN_META_LEN
 
 
 def _join_meta_row(x, op_or_root: int) -> np.ndarray:
@@ -222,6 +232,9 @@ class Engine:
         self._outstanding: Dict[str, Handle] = {}
         self._lock = threading.Lock()
         self._auto_counter = {}
+        # blocking metadata read-backs performed (see _fetch_exchange);
+        # the steady-state eager allreduce path must not grow this
+        self.host_fetches = 0
         # observability hooks, wired by GlobalState when timeline/stall are on
         self.on_enqueue: Optional[Callable[[str, str, int], None]] = None
         self.on_done: Optional[Callable[[str], None]] = None
@@ -272,13 +285,15 @@ class Engine:
         self._last_builder_fresh = fn is None
         if fn is None:
             # The builder cache is the ResponseCache analog
-            # (response_cache.h:45-102); HOROVOD_CACHE_CAPACITY bounds it the
-            # same way (FIFO eviction — steady-state jobs reuse a small,
-            # stable set of keys).
+            # (response_cache.h:45-102); HOROVOD_CACHE_CAPACITY bounds it
+            # with LRU eviction, so a working set one entry over capacity
+            # doesn't re-trace its hottest builder every cycle (ADVICE r2).
             if len(self._builders) >= max(self.config.cache_capacity, 1):
                 self._builders.pop(next(iter(self._builders)))
             fn = make()
-            self._builders[key] = fn
+        else:
+            del self._builders[key]  # re-insert -> most-recently-used
+        self._builders[key] = fn
         return fn
 
     def _auto_name(self, kind: str) -> str:
@@ -335,32 +350,57 @@ class Engine:
         self._join_substitute = False
         return sub
 
+    def _join_head(self, flag: int, rounds: int, kind_code: int,
+                   metas) -> np.ndarray:
+        """Build the fixed-shape join-round vector:
+        [flag, rounds, kind, k, meta_slot_0.., zero padding]."""
+        vec = np.zeros((_JOIN_HEAD_LEN,), dtype=np.int64)
+        k = len(metas) if metas is not None else 0
+        vec[0:4] = (flag, rounds, kind_code, k)
+        if k:
+            inline = metas[:_JOIN_META_SLOTS]
+            vec[4:4 + len(inline) * _JOIN_META_LEN] = np.concatenate(inline)
+        return vec
+
     def _join_sync(self, kind: str, metas, skip: bool = False,
                    root_rank: Optional[int] = None):
-        """Per-op join round. Round A is one tiny fixed-size allgather; the
-        metadata round B runs only when round A shows a joined rank (the
-        common no-join case pays a single 4-int64 exchange). Active ranks
-        advertise the op they are about to run; ranks sitting in join() use
-        the same rounds to learn what zero-tensor substitute to dispatch.
-        ``skip=True`` on the substitute dispatch itself — its rounds already
+        """Per-op join round — **fire-and-forget on the hot path**. One
+        fixed-shape allgather carries [active-flag, kind, k, metadata...];
+        active ranks dispatch it asynchronously and never read the result,
+        so the steady state pays one extra tiny collective launch and ZERO
+        host round-trips per op (the role of the reference's per-cycle
+        bit-vector fast path, controller.cc:133-203, re-thought for SPMD:
+        readiness negotiation is unnecessary, only joined ranks need the
+        advertisement, and they are blocked in join() with time to read it).
+        Ranks sitting in join() fetch the round, learn the op, and dispatch
+        a matching zero-tensor substitute in the same program order.
+
+        ``root_rank`` (broadcast) forces the only blocking variant: a joined
+        root has no data, every rank must raise *before* the real broadcast
+        is dispatched, so the active side reads the round back.
+        ``skip=True`` on the substitute dispatch itself — its round already
         ran inside the join() loop."""
         if skip or not self.config.join_enabled or self.backend.size() <= 1:
             return
         k = len(metas)
-        head = np.array([0, 0, _KIND_CODES[kind], k], dtype=np.int64)
-        world = self._exchange_sizes(head)
-        any_joined = bool((world[:, 0] == 1).any())
-        if k and any_joined:
-            # round B must complete BEFORE any error below — the joined
-            # ranks are mid-exchange and would hang otherwise
-            self._exchange_sizes(np.concatenate(metas))
-        if root_rank is not None and world[root_rank, 0] == 1:
-            # A joined root has no data: substituting zeros would silently
-            # corrupt every receiver (the reference errors a joined
-            # broadcast root).
-            raise HorovodInternalError(
-                f"broadcast root rank {root_rank} has already joined and "
-                f"has no data to broadcast")
+        head = self._join_head(0, 0, _KIND_CODES[kind], metas)
+        garr = self._dispatch_exchange(head)
+        if k > _JOIN_META_SLOTS:
+            # overflow metadata: both sides derive this exchange's existence
+            # and shape from the head (k > slots), so it stays async too
+            self._dispatch_exchange(
+                np.concatenate(metas[_JOIN_META_SLOTS:]))
+        if root_rank is not None:
+            world = self._fetch_exchange(garr, (_JOIN_HEAD_LEN,))
+            if world[root_rank, 0] == 1:
+                # A joined root has no data: substituting zeros would
+                # silently corrupt every receiver (the reference errors a
+                # joined broadcast root). Raising here, before the real
+                # broadcast is dispatched, keeps every rank's collective
+                # sequence aligned (the joined ranks raise in join()).
+                raise HorovodInternalError(
+                    f"broadcast root rank {root_rank} has already joined "
+                    f"and has no data to broadcast")
 
     def join(self) -> int:
         """This rank is out of data: keep matching peers' collectives with
@@ -375,8 +415,7 @@ class Engine:
             return size - 1
         rounds = 0
         while True:
-            head = self._exchange_sizes(
-                np.array([1, rounds, 0, 0], dtype=np.int64))
+            head = self._exchange_sizes(self._join_head(1, rounds, 0, None))
             joined = head[:, 0] == 1
             if joined.all():
                 # everyone is in join(): the last joiner has the fewest
@@ -390,15 +429,26 @@ class Engine:
             k = int(head[act, 3])
             metas = None
             if k:
-                flat = self._exchange_sizes(
-                    np.zeros((k * _JOIN_META_LEN,), dtype=np.int64))
-                metas = flat[act].reshape(k, _JOIN_META_LEN)
-            if kind_code == _KIND_CODES["broadcast"] and metas is not None \
-                    and int(metas[0][0]) == self.backend.rank():
-                # the active ranks raise on their side of this round too
-                raise HorovodInternalError(
-                    "this rank is the broadcast root but has already "
-                    "joined; it has no data to broadcast")
+                inline = min(k, _JOIN_META_SLOTS)
+                metas = head[act, 4:4 + inline * _JOIN_META_LEN] \
+                    .reshape(inline, _JOIN_META_LEN)
+                if k > _JOIN_META_SLOTS:
+                    flat = self._exchange_sizes(np.zeros(
+                        ((k - _JOIN_META_SLOTS) * _JOIN_META_LEN,),
+                        dtype=np.int64))
+                    metas = np.concatenate(
+                        [metas,
+                         flat[act].reshape(-1, _JOIN_META_LEN)])
+            if kind_code == _KIND_CODES["broadcast"] and metas is not None:
+                root = int(metas[0][0])
+                if root == self.backend.rank() or head[root, 0] == 1:
+                    # a joined broadcast root has no data — every joined
+                    # rank raises (not only the root itself: dispatching a
+                    # substitute nobody matches would hang, ADVICE r2), and
+                    # the active ranks raise on their blocking round
+                    raise HorovodInternalError(
+                        f"broadcast root rank {root} has already joined; "
+                        f"it has no data to broadcast")
             self._dispatch_substitute(kind_code, metas)
             rounds += 1
 
@@ -816,19 +866,33 @@ class Engine:
 
     # -- helpers -----------------------------------------------------------
 
+    def _dispatch_exchange(self, local_vec: np.ndarray) -> jax.Array:
+        """Launch a tiny metadata allgather WITHOUT waiting: returns the
+        global array future. The join fast path relies on this being
+        fire-and-forget (no host round-trip on the active ranks)."""
+        mesh = self.backend.group_mesh
+        fn = self._builder(("allgather",),
+                           lambda: C.build_allgather(mesh, self._axis()))
+        return _translate_failure(
+            lambda: fn(self.backend.to_global(jnp.asarray(local_vec))))
+
+    def _fetch_exchange(self, garr: jax.Array, vec_shape) -> np.ndarray:
+        """Blocking read-back of a _dispatch_exchange result. Every call is
+        one host round-trip; ``host_fetches`` counts them so tests (and the
+        bench) can assert the steady-state eager path performs none."""
+        self.host_fetches += 1
+        local = self.backend.from_replicated(garr)
+        return _translate_failure(np.asarray, local).reshape(
+            self.backend.size(), *vec_shape)
+
     def _exchange_sizes(self, local_vec: np.ndarray) -> np.ndarray:
         """Tiny metadata allgather used by unequal allgather/alltoall; the
         eager analog of the controller's size negotiation. Blocking (returns
         concrete numpy)."""
         if self.backend.size() == 1:
             return np.asarray(local_vec)[None]
-        mesh = self.backend.group_mesh
-        fn = self._builder(("allgather",), lambda: C.build_allgather(mesh, self._axis()))
-        garr = _translate_failure(
-            lambda: fn(self.backend.to_global(jnp.asarray(local_vec))))
-        local = self.backend.from_replicated(garr)
-        return _translate_failure(np.asarray, local).reshape(
-            self.backend.size(), *local_vec.shape)
+        garr = self._dispatch_exchange(local_vec)
+        return self._fetch_exchange(garr, np.asarray(local_vec).shape)
 
 
 def bucket_by_size(tensors: Sequence[jax.Array], threshold_bytes: int) -> List[List[int]]:

@@ -71,6 +71,12 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 sig, _sign(service.key, verb, ts, body)):
             self._respond(401, {"error": "bad or stale signature"})
             return
+        # Replay protection (ADVICE r2): a captured request is valid for the
+        # whole freshness window unless its exact (timestamp, signature) is
+        # remembered and rejected on re-use.
+        if not service.note_signature(ts, sig):
+            self._respond(401, {"error": "replayed request"})
+            return
         try:
             payload = json.loads(body) if body else {}
             result = service.handle(verb, payload)
@@ -104,6 +110,35 @@ class TaskService:
         self._exit_code: Optional[int] = None
         self._error: Optional[str] = None
         self._cmd_thread: Optional[threading.Thread] = None
+        # replay cache: signatures seen inside the freshness window (bounded
+        # well above any legitimate request rate for a 300 s window)
+        self._seen_sigs: Dict[str, float] = {}
+        self._seen_cap = 4096
+
+    def note_signature(self, ts: str, sig: str) -> bool:
+        """Record a (timestamp, signature) pair; False if already seen
+        (replay). An entry must outlive its *request timestamp's* freshness
+        window, not its arrival time: a future-skewed request (ts up to
+        MAX_CLOCK_SKEW_S ahead) stays replayable until `now - ts` exceeds
+        the window, so expiring by arrival time would reopen it."""
+        import time as _time
+        now = _time.time()
+        try:
+            req_ts = float(ts)
+        except ValueError:
+            return False
+        key = f"{ts}:{sig}"
+        with self._lock:
+            for k, t in list(self._seen_sigs.items()):
+                if now - t > MAX_CLOCK_SKEW_S:
+                    del self._seen_sigs[k]
+            if key in self._seen_sigs:
+                return False
+            if len(self._seen_sigs) >= self._seen_cap:
+                self._seen_sigs.pop(next(iter(self._seen_sigs)))
+            # remember until the request's own window closes
+            self._seen_sigs[key] = max(now, req_ts)
+            return True
 
     # -- lifecycle ----------------------------------------------------------
 

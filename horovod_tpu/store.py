@@ -32,15 +32,16 @@ def _orbax():
 
 
 class Store:
-    """Factory (parity: spark/common/store.py Store.create)."""
+    """Factory (parity: spark/common/store.py Store.create, which routes
+    hdfs:// to HDFSStore at store.py:256). The TPU-world remote filesystem
+    is GCS: any ``scheme://`` prefix is handed to fsspec (gs://, s3://,
+    memory:// for tests...), which is what preemptible-VM elastic jobs
+    should checkpoint to."""
 
     @staticmethod
-    def create(prefix_path: str) -> "LocalStore":
+    def create(prefix_path: str) -> "Store":
         if "://" in prefix_path and not prefix_path.startswith("file://"):
-            raise ValueError(
-                f"unsupported store scheme in {prefix_path!r}; only local "
-                f"filesystem stores are built in (subclass LocalStore for "
-                f"remote filesystems)")
+            return RemoteStore(prefix_path)
         return LocalStore(prefix_path.removeprefix("file://"))
 
 
@@ -112,10 +113,14 @@ class LocalStore(Store):
                 return None
         path = self._step_dir(run_id, step)
         ocp = _orbax()
-        if ocp is not None and not os.path.exists(
-                os.path.join(path, "leaves.npz")):
-            with ocp.PyTreeCheckpointer() as ckptr:
-                return ckptr.restore(path)
+        if not os.path.exists(os.path.join(path, "leaves.npz")):
+            if ocp is not None:
+                with ocp.PyTreeCheckpointer() as ckptr:
+                    return ckptr.restore(path)
+            raise RuntimeError(
+                f"checkpoint at {path} was written with orbax "
+                f"(no leaves.npz fallback present); install "
+                f"orbax-checkpoint to restore it (ADVICE r2)")
         import jax
         data = np.load(os.path.join(path, "leaves.npz"))
         with open(os.path.join(path, "treedef.pkl"), "rb") as f:
@@ -128,4 +133,113 @@ class LocalStore(Store):
         if not os.path.isdir(d):
             return []
         return sorted(int(n.split("_", 1)[1]) for n in os.listdir(d)
+                      if n.startswith("step_"))
+
+
+class RemoteStore(Store):
+    """fsspec-backed store for remote filesystems (gs://, s3://, hdfs://,
+    memory:// for tests) — the HDFSStore role (reference
+    spark/common/store.py:256) for the TPU world, where elastic jobs on
+    preemptible VMs must checkpoint off-host.
+
+    Checkpoints are written in the npz+treedef format (bytes through
+    fsspec), which round-trips through LocalStore.load_checkpoint too; the
+    ``latest`` pointer is a JSON object. Same layout as LocalStore:
+    ``<prefix>/runs/<run_id>/checkpoints/step_N``.
+    """
+
+    def __init__(self, prefix_url: str):
+        try:
+            import fsspec
+        except ImportError as e:
+            raise ValueError(
+                f"remote store {prefix_url!r} requires fsspec (plus the "
+                f"scheme's driver, e.g. gcsfs for gs://)") from e
+        self.prefix_path = prefix_url.rstrip("/")
+        self.fs, _ = fsspec.core.url_to_fs(self.prefix_path)
+
+    # -- paths --------------------------------------------------------------
+
+    def run_path(self, run_id: str) -> str:
+        return f"{self.prefix_path}/runs/{run_id}"
+
+    def checkpoint_dir(self, run_id: str) -> str:
+        return f"{self.run_path(run_id)}/checkpoints"
+
+    def logs_path(self, run_id: str) -> str:
+        return f"{self.run_path(run_id)}/logs"
+
+    def exists(self, path: str) -> bool:
+        return self.fs.exists(path)
+
+    # -- checkpoints --------------------------------------------------------
+
+    def _step_dir(self, run_id: str, step: int) -> str:
+        return f"{self.checkpoint_dir(run_id)}/step_{step}"
+
+    def save_checkpoint(self, run_id: str, step: int, pytree: Any) -> str:
+        import io
+        import jax
+        path = self._step_dir(run_id, step)
+        host_tree = jax.tree_util.tree_map(np.asarray, pytree)
+        leaves, treedef = jax.tree_util.tree_flatten(host_tree)
+        buf = io.BytesIO()
+        np.savez(buf, **{str(i): leaf for i, leaf in enumerate(leaves)})
+        with self.fs.open(f"{path}/leaves.npz", "wb") as f:
+            f.write(buf.getvalue())
+        with self.fs.open(f"{path}/treedef.pkl", "wb") as f:
+            f.write(pickle.dumps(treedef))
+        # write-then-rename like LocalStore: a preemption mid-write must not
+        # leave a truncated pointer (this class exists for preemptible VMs)
+        latest = f"{self.checkpoint_dir(run_id)}/latest"
+        tmp = f"{latest}.tmp.{os.getpid()}"
+        with self.fs.open(tmp, "w") as f:
+            json.dump({"step": step}, f)
+        try:
+            self.fs.mv(tmp, latest)
+        except Exception:
+            # object stores without rename: fall back to direct write
+            with self.fs.open(latest, "w") as f:
+                json.dump({"step": step}, f)
+            try:
+                self.fs.rm(tmp)
+            except Exception:
+                pass
+        return path
+
+    def latest_checkpoint_step(self, run_id: str) -> Optional[int]:
+        p = f"{self.checkpoint_dir(run_id)}/latest"
+        if not self.fs.exists(p):
+            return None
+        try:
+            with self.fs.open(p, "r") as f:
+                return int(json.load(f)["step"])
+        except (ValueError, KeyError):
+            # truncated pointer (crashed writer on a non-atomic backend):
+            # recover from the step directories instead of crashing resume
+            steps = self.checkpoint_steps(run_id)
+            return steps[-1] if steps else None
+
+    def load_checkpoint(self, run_id: str, step: Optional[int] = None) -> Any:
+        import io
+        import jax
+        if step is None:
+            step = self.latest_checkpoint_step(run_id)
+            if step is None:
+                return None
+        path = self._step_dir(run_id, step)
+        with self.fs.open(f"{path}/leaves.npz", "rb") as f:
+            data = np.load(io.BytesIO(f.read()))
+        with self.fs.open(f"{path}/treedef.pkl", "rb") as f:
+            treedef = pickle.loads(f.read())
+        leaves = [data[str(i)] for i in range(len(data.files))]
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def checkpoint_steps(self, run_id: str) -> List[int]:
+        d = self.checkpoint_dir(run_id)
+        if not self.fs.exists(d):
+            return []
+        names = [str(p).rstrip("/").rsplit("/", 1)[-1]
+                 for p in self.fs.ls(d, detail=False)]
+        return sorted(int(n.split("_", 1)[1]) for n in names
                       if n.startswith("step_"))

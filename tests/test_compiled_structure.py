@@ -1,0 +1,151 @@
+"""Compiled-program structure assertions (VERDICT r2 item 3).
+
+Multi-chip perf can't be *measured* on this rig (one real chip), but the
+*structure* of the compiled programs — the thing that determines collective
+count and fusion on a real pod — can be asserted on the 8-virtual-device CPU
+mesh: grouped_allreduce must compile to one collective per fusion bucket,
+hierarchical allreduce must lower to the RS/AG ladder with node-local
+``replica_groups``, EP dispatch must be a single all-to-all, and the SPMD
+flagship step must contain gradient all-reduces at all.
+
+Reference bar: fusion as *the* latency optimization
+(controller.cc:652-773 FuseResponses); hierarchical decomposition
+(nccl_operations.cc:180-383).
+"""
+
+import re
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from horovod_tpu.common.reduce_ops import ReduceOp
+from horovod_tpu.ops import collectives as C
+
+
+def _world_mesh(n=8):
+    devs = jax.devices()[:n]
+    return Mesh(np.array(devs), ("world",))
+
+
+def _hlo(jitted, *args):
+    return jitted.lower(*args).compile().as_text()
+
+
+def _count(pattern, hlo):
+    return len(re.findall(pattern, hlo))
+
+
+def test_fused_allreduce_is_one_collective_per_bucket():
+    """50 small tensors packed into one bucket -> exactly ONE all-reduce in
+    the optimized HLO (the fusion-buffer guarantee)."""
+    mesh = _world_mesh()
+    shapes = tuple((7, 3) for _ in range(50))
+    fn = C.build_fused_allreduce(mesh, "world", ReduceOp.SUM, shapes,
+                                 jnp.float32, 1.0, 1.0, 0)
+    total = sum(int(np.prod(s)) for s in shapes)
+    packed = jnp.zeros((8, total), jnp.float32)  # stacked (n, total)
+    garr = jax.device_put(packed, NamedSharding(mesh, P("world")))
+    hlo = _hlo(fn, garr)
+    n_ar = _count(r"all-reduce(?:-start)?\(", hlo)
+    assert n_ar == 1, f"expected 1 fused all-reduce, found {n_ar}"
+
+
+def test_bucketing_bounds_collective_count():
+    """bucket_by_size: 20 tensors under a threshold that forces 4 buckets ->
+    at most 4 collectives across the bucket programs."""
+    from horovod_tpu.core.engine import bucket_by_size
+    tensors = [jnp.ones((256,), jnp.float32) for _ in range(20)]
+    # 256 floats = 1 KiB each; 5 KiB threshold -> 5 per bucket -> 4 buckets
+    buckets = bucket_by_size(tensors, 5 * 1024)
+    assert len(buckets) == 4
+    mesh = _world_mesh()
+    total_collectives = 0
+    for idxs in buckets:
+        shapes = tuple((256,) for _ in idxs)
+        fn = C.build_fused_allreduce(mesh, "world", ReduceOp.SUM, shapes,
+                                     jnp.float32, 1.0, 1.0, 0)
+        packed = jax.device_put(
+            jnp.zeros((8, 256 * len(idxs)), jnp.float32),
+            NamedSharding(mesh, P("world")))
+        total_collectives += _count(r"all-reduce(?:-start)?\(", _hlo(fn, packed))
+    assert total_collectives == 4
+
+
+def test_hierarchical_allreduce_lowers_to_ladder():
+    """local_size=4 on 8 devices: reduce-scatter within node, all-reduce
+    across nodes, all-gather back — with 2-node replica groups of size 4."""
+    mesh = _world_mesh()
+    fn = C.build_hierarchical_allreduce(mesh, "world", 4, ReduceOp.SUM,
+                                        1.0, 1.0)
+    x = jax.device_put(jnp.zeros((64,), jnp.float32),
+                       NamedSharding(mesh, P()))
+    hlo = _hlo(fn, x)
+    # the RS/AG ladder: at least one reduce-scatter and one all-gather (XLA
+    # may lower psum_scatter to reduce-scatter or all-reduce+slice depending
+    # on backend; accept either spelling but require node-local groups)
+    has_ladder = (_count(r"reduce-scatter", hlo) >= 1
+                  or _count(r"all-reduce", hlo) >= 2)
+    assert has_ladder, "hierarchical program collapsed to a flat all-reduce"
+    assert _count(r"all-gather", hlo) >= 1, "missing all-gather stage"
+    # node-local replica groups {0..3} {4..7} must appear somewhere
+    local_groups = re.search(r"replica_groups=\{\{0,1,2,3\},\{4,5,6,7\}\}",
+                             hlo.replace(" ", ""))
+    assert local_groups, "no node-local (0-3 / 4-7) replica groups in HLO"
+
+
+def test_moe_dispatch_is_single_all_to_all():
+    """EP token dispatch over the tensor axis: exactly one all-to-all each
+    way (dispatch + return), not per-expert sends."""
+    from horovod_tpu.parallel.moe import MoEParams, moe_layer_p
+    n, d, e, f = 8, 16, 8, 32
+    mesh = _world_mesh()
+    router = jnp.zeros((d, e), jnp.float32)
+    w1 = jnp.zeros((e, d, f), jnp.float32)
+    w2 = jnp.zeros((e, f, d), jnp.float32)
+
+    def body(tok, router, w1, w2):
+        y, aux = moe_layer_p(tok, MoEParams(router, w1, w2), "world", n,
+                             capacity_factor=2.0)
+        return y, jax.lax.pmean(aux, "world")
+
+    tok_sh = NamedSharding(mesh, P("world"))
+    rep = NamedSharding(mesh, P())
+    ep_sh = NamedSharding(mesh, P("world"))
+    import functools
+    from jax import shard_map
+    fn = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P("world"), P(), P("world"), P("world")),
+        out_specs=(P("world"), P())))
+    tok = jax.device_put(jnp.zeros((n * 4, d), jnp.float32), tok_sh)
+    hlo = _hlo(fn, tok, jax.device_put(router, rep),
+               jax.device_put(w1, ep_sh), jax.device_put(w2, ep_sh))
+    n_a2a = _count(r"all-to-all(?:-start)?\(", hlo)
+    assert 1 <= n_a2a <= 2, f"EP dispatch should be 1-2 all-to-alls, got {n_a2a}"
+
+
+def test_flagship_spmd_step_contains_gradient_reduction():
+    """The flagship transformer train step over (data=2, seq=2, tensor=2)
+    compiles with collective ops present (the gradient psum the reference
+    implements as NCCLAllreduce)."""
+    import optax
+    from horovod_tpu.models.transformer import (TransformerConfig,
+                                                init_params, make_train_step,
+                                                shard_params)
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("data", "seq", "tensor"))
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_seq=16, dtype=jnp.float32)
+    params = shard_params(init_params(jax.random.PRNGKey(0), cfg), mesh, cfg)
+    opt = optax.sgd(0.01)
+    step = make_train_step(mesh, cfg, opt)
+    tok = jax.device_put(jnp.zeros((4, 16), jnp.int32),
+                         NamedSharding(mesh, P("data", "seq")))
+    opt_state = opt.init(params)
+    hlo = step.lower(params, opt_state, tok, tok).compile().as_text()
+    n_coll = (_count(r"all-reduce", hlo) + _count(r"reduce-scatter", hlo)
+              + _count(r"all-gather", hlo) + _count(r"collective-permute", hlo))
+    assert n_coll >= 3, f"expected gradient/activation collectives, got {n_coll}"

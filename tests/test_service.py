@@ -227,3 +227,41 @@ def test_same_host_agents_get_distinct_local_ranks(tmp_path):
         command=[sys.executable, str(script)], base_env=env, timeout=60)
     a0.stop(); a1.stop()
     assert sorted(p.name for p in out.iterdir()) == ["lr_0", "lr_1"]
+
+
+def test_replayed_request_rejected():
+    """A verbatim re-send of a captured signed request must be rejected
+    inside the freshness window (ADVICE r2 replay finding)."""
+    import time
+    import urllib.request
+    import urllib.error
+    from horovod_tpu.runner.service import (TaskService, make_secret_key,
+                                            _sign, SIG_HEADER, TS_HEADER)
+
+    key = make_secret_key()
+    svc = TaskService(key, addr=("127.0.0.1", 0))
+    svc.start()
+    try:
+        port = svc.port if hasattr(svc, "port") else \
+            svc._httpd.server_address[1]
+        url = f"http://127.0.0.1:{port}/probe"
+        body = b"{}"
+        ts = str(time.time())
+        sig = _sign(key, "probe", ts, body)
+
+        def send():
+            req = urllib.request.Request(url, data=body, method="POST")
+            req.add_header(SIG_HEADER, sig)
+            req.add_header(TS_HEADER, ts)
+            try:
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    return r.status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        first = send()
+        replay = send()
+        assert first != 401, "legitimate signed request rejected"
+        assert replay == 401, "replayed request accepted"
+    finally:
+        svc.stop()
