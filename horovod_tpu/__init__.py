@@ -266,6 +266,7 @@ from . import functions as _functions  # noqa: E402
 broadcast_parameters = _functions.broadcast_parameters
 broadcast_object = _functions.broadcast_object
 allgather_object = _functions.allgather_object
+allreduce_sparse = _functions.allreduce_sparse
 broadcast_optimizer_state = _functions.broadcast_optimizer_state
 from . import elastic  # noqa: E402
 
@@ -277,6 +278,7 @@ __all__ = [
     "alltoall", "alltoall_async", "reducescatter", "reducescatter_async",
     "barrier", "join", "poll", "synchronize", "step_heartbeat",
     "broadcast_parameters", "broadcast_object", "allgather_object",
+    "allreduce_sparse",
     "broadcast_optimizer_state",
     "DistributedOptimizer", "Compression", "optimizer", "elastic",
     "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max", "Product",

@@ -13,6 +13,21 @@ where ranks may measure slightly different wall-clock.
 Tuned knobs (log₂-scaled, like the reference's NumericParameter scaling):
 - fusion_threshold_bytes ∈ [1 MB, 256 MB]
 - cycle_time_ms ∈ [1, 25]
+
+Categorical knobs (parameter_manager.h:225-228 tunes hierarchical
+allreduce/allgather and cache enablement the same way): each enabled
+categorical is one [0, 1] GP dimension, thresholded at 0.5 when read —
+the topology-dependent on/off choices (hierarchical ladders, Pallas
+packing) that a static default cannot make per cluster:
+- hierarchical_allreduce / hierarchical_allgather (offered when
+  local_size > 1)
+- pallas_pack (offered when Pallas is available)
+
+Scoring: the interval between successive ``step_mark`` calls spans one
+full training step (mark fires at grouped-allreduce entry each step), so
+score = bytes/interval is end-to-end step throughput, not
+collective-only time — a knob that speeds the collective but slows the
+step scores worse.
 """
 
 from __future__ import annotations
@@ -42,10 +57,15 @@ class ParameterManager:
                  initial_threshold: int = 64 * MB,
                  initial_cycle_ms: float = 5.0,
                  log_path: Optional[str] = None,
-                 bcast_object: Optional[Callable] = None):
-        # search space in log2 units
+                 bcast_object: Optional[Callable] = None,
+                 categorical: Optional[List[str]] = None,
+                 categorical_initial: Optional[dict] = None):
+        # search space: 2 numeric dims in log2 units + one [0,1] dim per
+        # categorical knob (parameter_manager.h:225-228)
+        self._categorical = list(categorical or [])
         self._bounds = [(np.log2(1 * MB), np.log2(256 * MB)),
                         (np.log2(1.0), np.log2(25.0))]
+        self._bounds += [(0.0, 1.0)] * len(self._categorical)
         self._opt = BayesianOptimizer(self._bounds, noise=gp_noise)
         self._warmup_remaining = warmup_samples
         self._steps_per_sample = steps_per_sample
@@ -53,8 +73,10 @@ class ParameterManager:
         self._bcast_object = bcast_object
 
         self._active = True
+        init_cat = [1.0 if (categorical_initial or {}).get(name) else 0.0
+                    for name in self._categorical]
         self._current = np.array([np.log2(initial_threshold),
-                                  np.log2(initial_cycle_ms)])
+                                  np.log2(initial_cycle_ms)] + init_cat)
         self._scores: List[float] = []
         self._step_bytes = 0
         self._step_start: Optional[float] = None
@@ -62,8 +84,10 @@ class ParameterManager:
         self._log_path = log_path
         self._log_file = open(log_path, "w") if log_path else None
         if self._log_file:
+            cat_cols = "".join(f",{c}" for c in self._categorical)
             self._log_file.write(
-                "sample,fusion_threshold_bytes,cycle_time_ms,score_bytes_per_sec\n")
+                f"sample,fusion_threshold_bytes,cycle_time_ms{cat_cols}"
+                f",score_bytes_per_sec\n")
 
     # -- public knob values --------------------------------------------------
 
@@ -82,6 +106,15 @@ class ParameterManager:
     @property
     def n_samples_taken(self) -> int:
         return self._opt.n_samples
+
+    def tunes(self, name: str) -> bool:
+        """Whether ``name`` is a tuned categorical dimension."""
+        return name in self._categorical
+
+    def categorical_value(self, name: str) -> bool:
+        """Current on/off value of a tuned categorical knob."""
+        i = self._categorical.index(name)
+        return bool(self._current[2 + i] >= 0.5)
 
     # -- scoring loop --------------------------------------------------------
 
@@ -119,9 +152,11 @@ class ParameterManager:
             return
         self._opt.register(self._current.copy(), score)
         if self._log_file:
+            cats = "".join(f",{int(self.categorical_value(c))}"
+                           for c in self._categorical)
             self._log_file.write(
                 f"{self._opt.n_samples},{self.fusion_threshold_bytes},"
-                f"{self.cycle_time_ms:.3f},{score:.1f}\n")
+                f"{self.cycle_time_ms:.3f}{cats},{score:.1f}\n")
             self._log_file.flush()
         if self._opt.n_samples >= self._max_samples:
             best_x, best_y = self._opt.best()
@@ -129,13 +164,17 @@ class ParameterManager:
             self._active = False
             self._sync_params()
             _LOG.info(
-                "autotune converged: fusion=%d MB cycle=%.1f ms "
+                "autotune converged: fusion=%d MB cycle=%.1f ms %s "
                 "(%.1f MB/s)", self.fusion_threshold_bytes // MB,
-                self.cycle_time_ms, best_y / MB)
+                self.cycle_time_ms,
+                {c: self.categorical_value(c) for c in self._categorical},
+                best_y / MB)
             if self._log_file:
+                cats = "".join(f",{int(self.categorical_value(c))}"
+                               for c in self._categorical)
                 self._log_file.write(
                     f"best,{self.fusion_threshold_bytes},"
-                    f"{self.cycle_time_ms:.3f},{best_y:.1f}\n")
+                    f"{self.cycle_time_ms:.3f}{cats},{best_y:.1f}\n")
                 self._log_file.flush()
                 self._log_file.close()
                 self._log_file = None

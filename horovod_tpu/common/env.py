@@ -61,6 +61,9 @@ HOROVOD_TPU_PROCESS_ID = "HOROVOD_TPU_PROCESS_ID"
 # elastic mode so peer crashes surface quickly (core/backend.py init())
 HOROVOD_TPU_HEARTBEAT_TIMEOUT = "HOROVOD_TPU_HEARTBEAT_TIMEOUT"
 HOROVOD_TPU_SHUTDOWN_TIMEOUT = "HOROVOD_TPU_SHUTDOWN_TIMEOUT"
+# coordinator-last teardown: how long rank 0 waits for peers'
+# disconnect flags before shutting the coordination service
+HOROVOD_TPU_SHUTDOWN_ORDER_TIMEOUT = "HOROVOD_TPU_SHUTDOWN_ORDER_TIMEOUT"
 HOROVOD_TPU_DEBUG_CONSISTENCY = "HOROVOD_TPU_DEBUG_CONSISTENCY"
 HOROVOD_TPU_PLATFORM = "HOROVOD_TPU_PLATFORM"                 # cpu|tpu override (tests)
 

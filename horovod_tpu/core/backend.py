@@ -242,10 +242,65 @@ class Backend:
                                       key, timeout=timeout).decode()
         return addr, None
 
+    def _ordered_distributed_shutdown(self):
+        """Tear down the JAX distributed client with coordinator-last
+        ordering.
+
+        Recoverable mode (enabled for elastic worlds) removes the
+        coordination service's shutdown barrier, so teardown order becomes a
+        race: a non-zero rank whose ShutdownTask RPC finds rank 0's
+        in-process coordinator already gone is killed by an absl LOG(FATAL)
+        — uncatchable from Python, and the cause of the elastic scale-down
+        flake (the removed worker died hard instead of exiting cleanly).
+        Order is re-imposed through the launcher's KV, which outlives every
+        world: non-zero ranks disconnect first and post a flag; rank 0
+        collects the flags (bounded wait — a crashed peer never posts)
+        before tearing the service down."""
+        rdv_addr = os.environ.get(env_mod.HOROVOD_GLOO_RENDEZVOUS_ADDR)
+        rdv_port = os.environ.get(env_mod.HOROVOD_GLOO_RENDEZVOUS_PORT)
+        if not rdv_addr or not rdv_port or self._size <= 1:
+            jax.distributed.shutdown()
+            return
+        from ..runner.http_client import (put_data_into_kvstore,
+                                          read_data_from_kvstore)
+        import time as _time
+        version = os.environ.get("HOROVOD_TPU_WORLD_VERSION", "0")
+        scope = f"shutdown.v{version}"
+        if self._rank != 0:
+            try:
+                jax.distributed.shutdown()
+            finally:
+                try:
+                    put_data_into_kvstore(rdv_addr, int(rdv_port), scope,
+                                          str(self._rank), b"1", timeout=5)
+                except Exception:
+                    pass
+            return
+        deadline = _time.monotonic() + float(os.environ.get(
+            env_mod.HOROVOD_TPU_SHUTDOWN_ORDER_TIMEOUT, "10"))
+        # Poll every pending rank in short rounds instead of blocking the
+        # whole budget on the first one: a single dead low-rank peer must
+        # not starve the wait for live higher-rank peers (that would
+        # reintroduce the teardown race for them).
+        pending = set(range(1, self._size))
+        while pending and _time.monotonic() < deadline:
+            for r in sorted(pending):
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    read_data_from_kvstore(rdv_addr, int(rdv_port), scope,
+                                           str(r),
+                                           timeout=min(1.0, remaining))
+                    pending.discard(r)
+                except Exception:
+                    pass  # not posted yet (or dead peer): try others
+        jax.distributed.shutdown()
+
     def shutdown(self):
         if self._distributed:
             try:
-                jax.distributed.shutdown()
+                self._ordered_distributed_shutdown()
             except Exception:
                 pass
             self._distributed = False

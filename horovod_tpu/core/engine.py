@@ -672,13 +672,25 @@ class Engine:
                         [_join_meta_row(t, int(op)) for t in tensors],
                         skip=sub)
         pm = self.parameter_manager
-        if pm is not None and pm.active:
-            # program-ordered autotune step boundary: score the previous
-            # step, possibly retune knobs (collective sync inside is safe
-            # here — every rank hits this call in the same order)
-            pm.step_mark(sum(t.nbytes for t in tensors))
+        if pm is not None:
+            if pm.active:
+                # program-ordered autotune step boundary: score the previous
+                # step, possibly retune knobs (collective sync inside is
+                # safe here — every rank hits this call in the same order)
+                pm.step_mark(sum(t.nbytes for t in tensors))
+            # knob values apply while tuning AND after convergence (the
+            # winner must stick, controller.cc:34-48 SynchronizeParameters)
             self.config.fusion_threshold_bytes = pm.fusion_threshold_bytes
             self.config.cycle_time_ms = pm.cycle_time_ms
+            # categorical knobs (parameter_manager.h:225-228): hierarchy /
+            # Pallas-pack choices flip between samples, synchronized across
+            # ranks by the pm's rank-0 broadcast at sample boundaries
+            if pm.tunes("hierarchical_allreduce"):
+                self.config.hierarchical_allreduce = \
+                    pm.categorical_value("hierarchical_allreduce")
+            if pm.tunes("hierarchical_allgather"):
+                self.config.hierarchical_allgather = \
+                    pm.categorical_value("hierarchical_allgather")
         names = [self._register(None if name is None else f"{name}.{i}",
                                 "grouped_allreduce", t.nbytes)
                  for i, t in enumerate(tensors)]
@@ -700,7 +712,10 @@ class Engine:
             # collective_operations.cc:38-82).
             from ..ops.pallas_kernels import (pack_pallas,
                                               pack_pallas_enabled)
-            if pack_pallas_enabled():
+            use_pallas_pack = (pm.categorical_value("pallas_pack")
+                               if pm is not None and pm.tunes("pallas_pack")
+                               else pack_pallas_enabled())
+            if use_pallas_pack:
                 packed = _translate_failure(pack_pallas, bucket)
             else:
                 pack_fn = self._builder(("pack", shapes, str(dtype)),

@@ -55,7 +55,21 @@ class GlobalState:
 
         if cfg.autotune:
             from ..autotune.parameter_manager import ParameterManager
+            from ..ops.pallas_kernels import (pack_pallas_enabled,
+                                              pallas_supported)
             from .. import functions
+            # Categorical dimensions, offered only where the topology can
+            # express them (parameter_manager.h:225-228): the hierarchical
+            # ladders need >1 local rank; Pallas packing needs Pallas. The
+            # engine still collectively validates hierarchy at use time
+            # (_hierarchical_ok), so a heterogeneous topology degrades to
+            # flat — the GP then simply observes no score difference.
+            categorical = []
+            if self.backend.local_size() > 1:
+                categorical += ["hierarchical_allreduce",
+                                "hierarchical_allgather"]
+            if pallas_supported():
+                categorical += ["pallas_pack"]
             self.parameter_manager = ParameterManager(
                 warmup_samples=cfg.autotune_warmup_samples,
                 steps_per_sample=cfg.autotune_steps_per_sample,
@@ -66,7 +80,15 @@ class GlobalState:
                 log_path=(cfg.autotune_log
                           if self.backend.rank() == 0 else None),
                 bcast_object=(functions.broadcast_object
-                              if self.backend.size() > 1 else None))
+                              if self.backend.size() > 1 else None),
+                categorical=categorical,
+                categorical_initial={
+                    "hierarchical_allreduce": cfg.hierarchical_allreduce,
+                    "hierarchical_allgather": cfg.hierarchical_allgather,
+                    # seed from the user's env choice so enabling autotune
+                    # doesn't silently flip an explicitly-requested kernel
+                    "pallas_pack": pack_pallas_enabled(),
+                })
             self.engine.parameter_manager = self.parameter_manager
 
         engine = self.engine

@@ -1,0 +1,74 @@
+"""Sharded dataset ingestion for the estimator.
+
+Parity: the reference estimator's per-worker data path — DataFrame →
+parquet shards prepared by ``spark/common/util.py``, then a per-worker
+Petastorm reader loop inside the training closure
+(``spark/torch/remote.py:35-382``). The TPU-native equivalent is a
+directory of ``.npz`` shards read per rank, with NO equal-cardinality
+requirement: ranks may own different sample counts, and the estimator
+lets the ragged tail flow through the engine's Join protocol
+(``core/engine.py`` zero-tensor substitution) instead of dropping data.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+class ShardedNpzDataset:
+    """A dataset stored as npz shard files, each holding arrays ``x`` (inputs)
+    and ``y`` (labels).
+
+    Ranks take shard files round-robin (file i → rank i % size), mirroring
+    the reference's per-worker Petastorm row-group assignment. Shards may
+    have different sample counts — the estimator handles the resulting
+    ragged batch tails with ``hvd.join()``.
+    """
+
+    def __init__(self, paths: Sequence[str]):
+        if isinstance(paths, (str, os.PathLike)):
+            pattern = os.path.join(str(paths), "*.npz") \
+                if os.path.isdir(str(paths)) else str(paths)
+            paths = sorted(glob.glob(pattern))
+        self.paths: List[str] = [str(p) for p in paths]
+        if not self.paths:
+            raise ValueError("ShardedNpzDataset: no shard files found")
+
+    @staticmethod
+    def write_shards(directory: str, x: np.ndarray, y: np.ndarray,
+                     n_shards: int) -> "ShardedNpzDataset":
+        """Split (x, y) into ``n_shards`` npz files (the DataFrame→parquet
+        preparation role, spark/common/util.py). Shards are as even as
+        possible; the remainder makes the first shards one sample longer."""
+        os.makedirs(directory, exist_ok=True)
+        paths = []
+        bounds = np.linspace(0, len(x), n_shards + 1).astype(int)
+        for i in range(n_shards):
+            lo, hi = bounds[i], bounds[i + 1]
+            p = os.path.join(directory, f"shard_{i:05d}.npz")
+            np.savez(p, x=x[lo:hi], y=y[lo:hi])
+            paths.append(p)
+        return ShardedNpzDataset(paths)
+
+    def shard_arrays(self, rank: int, size: int
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        """Load this rank's shard files into memory-concatenated arrays."""
+        mine = self.paths[rank::size]
+        if not mine:
+            # more ranks than shards: this rank owns no data and will join()
+            # immediately — probe shard 0 for dtypes/shapes
+            probe = np.load(self.paths[0])
+            return (probe["x"][:0], probe["y"][:0])
+        xs, ys = [], []
+        for p in mine:
+            data = np.load(p)
+            xs.append(data["x"])
+            ys.append(data["y"])
+        return np.concatenate(xs), np.concatenate(ys)
+
+    def __len__(self) -> int:
+        return len(self.paths)

@@ -127,6 +127,25 @@ class ElasticDriver:
         with self._lock:
             return self._pending_resume
 
+    def wait_for_world(self, version: int, timeout: float = 60.0) -> bool:
+        """Block until a world with ``world_version >= version`` is fully
+        formed: assignments published, no resume pending, and every assigned
+        worker has rendezvoused READY. The event-driven synchronization hook
+        for tests and tooling (VERDICT r2 item 4) — replaces sleep-margin
+        guessing about when a world is up."""
+        from .registration import READY
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and not self._shutdown.is_set():
+            with self._lock:
+                formed = (self._world_version >= version and
+                          not self._pending_resume and
+                          bool(self._assignments))
+                expected = len(self._assignments)
+            if formed and self._registry.count(READY) >= expected:
+                return True
+            time.sleep(0.05)
+        return False
+
     def get_slot_info(self, host: str, local_rank: int) -> Optional[SlotInfo]:
         """Current assignment for a worker, or None while a resume is
         pending (the rendezvous turns None into a long-polled 404)."""

@@ -54,11 +54,14 @@ def launch_elastic_job(discovery, np: int, command: List[str],
                        identity_file: Optional[str] = None,
                        timeout: Optional[float] = None,
                        network_interfaces: Optional[List[str]] = None,
-                       verbose: bool = False) -> ElasticDriver:
+                       verbose: bool = False,
+                       driver_callback=None) -> ElasticDriver:
     """Start the rendezvous + driver and run ``command`` elastically.
 
     Blocks until the job finishes; raises on error. Returns the driver (for
     tests, which may prefer driver.wait_for_finished themselves).
+    ``driver_callback(driver)``, if given, fires as soon as the driver
+    exists — the hook tests use to synchronize on ``wait_for_world``.
     """
     min_np = min_np or np
     server = ElasticRendezvousServer()
@@ -67,6 +70,8 @@ def launch_elastic_job(discovery, np: int, command: List[str],
                            timeout=timeout, reset_limit=reset_limit,
                            verbose=verbose)
     server.set_driver(driver)
+    if driver_callback is not None:
+        driver_callback(driver)
 
     def _rdv_addr_for(slot: SlotInfo) -> str:
         # per-slot, not once at startup: a remote host added later must get

@@ -125,13 +125,22 @@ class Estimator:
 
     # -- public -------------------------------------------------------------
 
-    def fit(self, train_data: Tuple, val_data: Optional[Tuple] = None
+    def fit(self, train_data, val_data: Optional[Tuple] = None
             ) -> TrainedModel:
-        """Run the distributed train loop. ``train_data``/``val_data`` are
-        ``(inputs, labels)`` numpy arrays (the full dataset; each rank
-        trains on its shard, like the estimator's partitioned dataframe)."""
+        """Run the distributed train loop.
+
+        ``train_data`` is either ``(inputs, labels)`` numpy arrays (the full
+        dataset; each rank trains on an equal contiguous shard, like the
+        estimator's partitioned dataframe) or a
+        :class:`horovod_tpu.data.ShardedNpzDataset` (on-disk shards taken
+        round-robin per rank — the Petastorm reader-loop role,
+        spark/torch/remote.py:35-382). Sharded datasets may be UNEVEN: a
+        rank that runs out of batches joins (``hvd.join()``) and substitutes
+        zeros for the peers' remaining gradient reductions, so no data is
+        dropped and nothing deadlocks."""
         import horovod_tpu as hvd
         from . import functions
+        from .data import ShardedNpzDataset
         from .optimizer import DistributedEagerOptimizer
         from .ops.compression import Compression
 
@@ -158,8 +167,13 @@ class Estimator:
             start_epoch = int(functions.broadcast_object(start_epoch,
                                                          root_rank=0))
 
-        x, y = np.asarray(train_data[0]), np.asarray(train_data[1])
-        idx = self._shard(len(x), rank, size)
+        ragged = isinstance(train_data, ShardedNpzDataset)
+        if ragged:
+            x, y = train_data.shard_arrays(rank, size)
+            idx = np.arange(len(x))
+        else:
+            x, y = np.asarray(train_data[0]), np.asarray(train_data[1])
+            idx = self._shard(len(x), rank, size)
 
         grad_fn = jax.jit(jax.value_and_grad(self.loss_fn))
         history: List[dict] = []
@@ -170,8 +184,14 @@ class Estimator:
             if self.shuffle:
                 order = np.random.RandomState(self.seed + epoch).permutation(idx)
             losses = []
-            for lo in range(0, len(order) - self.batch_size + 1,
-                            self.batch_size):
+            if ragged:
+                # every batch trains, including the short tail; batch counts
+                # may differ across ranks — join() below squares that up
+                batch_starts = range(0, len(order), self.batch_size)
+            else:
+                batch_starts = range(0, len(order) - self.batch_size + 1,
+                                     self.batch_size)
+            for lo in batch_starts:
                 sel = order[lo:lo + self.batch_size]
                 bx = jnp.asarray(x[sel])
                 by = jnp.asarray(y[sel])
@@ -179,18 +199,29 @@ class Estimator:
                 params, opt_state = opt.update_and_apply(grads, opt_state,
                                                          params)
                 losses.append(loss)
+            if ragged and size > 1:
+                # out of data for this epoch: match any still-training peers'
+                # reductions with zero substitutes (reference join semantics
+                # for the uneven last batches, operations.cc:1004-1040)
+                hvd.join()
+            loss_sum = float(np.sum([float(np.asarray(l)) for l in losses])) \
+                if losses else 0.0
+            n_batches = len(losses)
             record = {"epoch": epoch,
-                      "train_loss": float(np.mean(
-                          [float(np.asarray(l)) for l in losses]))
-                      if losses else float("nan"),
                       "time_s": time.perf_counter() - t0}
             if val_data is not None:
                 record.update(self._validate(params, val_data, rank, size))
-            # metric averaging across ranks (MetricAverageCallback)
+            # metric averaging across ranks (MetricAverageCallback) —
+            # batch-count weighted, so a rank with an empty ragged shard
+            # contributes (0, 0) instead of poisoning the mean with NaN
             if size > 1:
-                record["train_loss"] = float(np.asarray(hvd.allreduce(
-                    np.float32(record["train_loss"]),
-                    name=f"est.loss.{epoch}", op=Average)))
+                from .common.reduce_ops import ReduceOp
+                totals = np.asarray(hvd.allreduce(
+                    np.array([loss_sum, float(n_batches)], np.float64),
+                    name=f"est.loss.{epoch}", op=ReduceOp.SUM))
+                loss_sum, n_batches = float(totals[0]), totals[1]
+            record["train_loss"] = (loss_sum / n_batches if n_batches
+                                    else float("nan"))
             history.append(record)
             if rank == 0:
                 _LOG.info("epoch %d: %s", epoch, record)

@@ -88,3 +88,45 @@ def allgather_object(obj: Any, name: Optional[str] = None) -> list:
         out.append(pickle.loads(gathered[off:off + int(s)].tobytes()))
         off += int(s)
     return out
+
+
+def allreduce_sparse(indices, values, n_rows: int,
+                     name: Optional[str] = None, average: bool = True):
+    """Sparse (row-indexed) gradient reduction via allgather — the
+    reference's IndexedSlices fallback (tensorflow/__init__.py:52-131:
+    sparse_as_dense=False allreduces IndexedSlices by allgathering
+    indices+values instead of densifying).
+
+    JAX gradients are dense, but embedding-heavy models can produce updates
+    touching few rows; callers that track (indices, values) explicitly can
+    reduce them without materializing the dense [n_rows, ...] tensor on the
+    wire. Returns ``(combined_indices, combined_values)``: the concatenation
+    of every rank's slices with duplicate rows summed (and divided by world
+    size when ``average``), sorted by index — applying them with a
+    scatter-add reproduces ``allreduce(dense)`` exactly.
+    """
+    eng = _engine()
+    indices = np.asarray(indices)
+    values = np.asarray(values)
+    if indices.shape[0] != values.shape[0]:
+        raise ValueError(
+            f"indices ({indices.shape[0]}) and values ({values.shape[0]}) "
+            f"must agree on dim 0")
+    if indices.size and (indices.min() < 0 or indices.max() >= n_rows):
+        raise ValueError(f"indices out of range [0, {n_rows})")
+    size = eng.backend.size()
+    name = name or "allreduce_sparse"
+    if size > 1:
+        hi = eng.allgather(indices.astype(np.int64), name=f"{name}.idx")
+        hv = eng.allgather(values, name=f"{name}.val")
+        all_idx = np.asarray(hi.synchronize())
+        all_val = np.asarray(hv.synchronize())
+    else:
+        all_idx, all_val = indices.astype(np.int64), values
+    # combine duplicate rows (np.add.at is the host-side scatter-add)
+    uniq, inverse = np.unique(all_idx, return_inverse=True)
+    combined = np.zeros((len(uniq),) + all_val.shape[1:], all_val.dtype)
+    np.add.at(combined, inverse, all_val)
+    if average:
+        combined = (combined / size).astype(all_val.dtype)
+    return uniq, combined

@@ -1,0 +1,83 @@
+"""Vision Transformer (ViT-B/16-style) — the framework's third model family
+(MLP, ResNet, decoder-LM, ViT).
+
+The reference is model-agnostic (its examples span MLP/word2vec/ResNet);
+model families here exist to exercise the framework end-to-end: ViT runs the
+encoder (non-causal) attention path through the same kernels as the flagship
+LM (`parallel/flash_attention.py` on TPU, materialized fallback elsewhere),
+NHWC patchify on the MXU, bf16 compute / fp32 params.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from ..parallel.flash_attention import flash_attention_local
+
+
+class EncoderBlock(nn.Module):
+    n_heads: int
+    d_ff: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        h = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        qkv = partial(nn.DenseGeneral, features=(self.n_heads, d // self.n_heads),
+                      dtype=self.dtype, param_dtype=jnp.float32, use_bias=False)
+        q, k, v = qkv(name="q")(h), qkv(name="k")(h), qkv(name="v")(h)
+        att = flash_attention_local(q, k, v, causal=False)
+        out = nn.DenseGeneral(features=d, axis=(-2, -1), dtype=self.dtype,
+                              param_dtype=jnp.float32, use_bias=False,
+                              name="o")(att)
+        x = x + out
+        h = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        h = nn.Dense(self.d_ff, dtype=self.dtype,
+                     param_dtype=jnp.float32)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(d, dtype=self.dtype, param_dtype=jnp.float32)(h)
+        return x + h
+
+
+class ViT(nn.Module):
+    num_classes: int = 1000
+    patch: int = 16
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, images, train: bool = True):
+        b, h, w, _ = images.shape
+        x = nn.Conv(self.d_model, (self.patch, self.patch),
+                    strides=(self.patch, self.patch), padding="VALID",
+                    dtype=self.dtype, param_dtype=jnp.float32,
+                    name="patchify")(images.astype(self.dtype))
+        x = x.reshape(b, -1, self.d_model)            # [B, T, D]
+        cls = self.param("cls", nn.initializers.zeros,
+                         (1, 1, self.d_model), jnp.float32)
+        x = jnp.concatenate([jnp.tile(cls.astype(self.dtype), (b, 1, 1)), x],
+                            axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, x.shape[1], self.d_model), jnp.float32)
+        x = x + pos.astype(self.dtype)
+        for i in range(self.n_layers):
+            x = EncoderBlock(self.n_heads, self.d_ff, self.dtype,
+                             name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        param_dtype=jnp.float32,
+                        name="head")(x[:, 0]).astype(jnp.float32)
+
+
+ViT_B16 = partial(ViT, d_model=768, n_layers=12, n_heads=12, d_ff=3072)
+ViT_S16 = partial(ViT, d_model=384, n_layers=12, n_heads=6, d_ff=1536)
+ViT_Tiny = partial(ViT, d_model=64, n_layers=2, n_heads=4, d_ff=128, patch=8)

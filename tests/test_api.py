@@ -156,3 +156,29 @@ def test_broadcast_object_and_parameters():
 
 def test_allgather_object():
     assert hvd.allgather_object({"r": 0}) == [{"r": 0}]
+
+
+def test_allreduce_sparse_single_process():
+    """Sparse row-indexed reduction (reference IndexedSlices fallback,
+    tensorflow/__init__.py:52-131): duplicates combine, result matches the
+    dense allreduce."""
+    import numpy as np
+    import horovod_tpu as hvd
+    hvd.init()
+    idx = np.array([3, 1, 3, 7])
+    val = np.array([[1.0, 1.0], [2.0, 2.0], [10.0, 10.0], [4.0, 4.0]],
+                   np.float32)
+    u, c = hvd.allreduce_sparse(idx, val, n_rows=10, average=False)
+    np.testing.assert_array_equal(u, [1, 3, 7])
+    np.testing.assert_allclose(c, [[2, 2], [11, 11], [4, 4]])
+    # equivalence with the dense path
+    dense = np.zeros((10, 2), np.float32)
+    np.add.at(dense, idx, val)
+    dense_out = np.asarray(hvd.allreduce(dense, name="sparse.ref",
+                                         op=hvd.Sum))
+    rebuilt = np.zeros_like(dense)
+    rebuilt[u] = c
+    np.testing.assert_allclose(rebuilt, dense_out)
+    import pytest
+    with pytest.raises(ValueError):
+        hvd.allreduce_sparse(np.array([11]), np.ones((1, 2)), n_rows=10)

@@ -119,3 +119,96 @@ class TestParameterManager:
                 os.environ.pop(k, None)
             hvd.shutdown()
             hvd.init()
+
+
+class TestCategoricalKnobs:
+    def test_categorical_dims_in_search_space(self):
+        from horovod_tpu.autotune.parameter_manager import ParameterManager
+        pm = ParameterManager(
+            warmup_samples=0, steps_per_sample=1, max_samples=5,
+            categorical=["hierarchical_allreduce", "pallas_pack"],
+            categorical_initial={"hierarchical_allreduce": False})
+        assert pm.tunes("hierarchical_allreduce")
+        assert pm.tunes("pallas_pack")
+        assert not pm.tunes("nonexistent")
+        assert pm.categorical_value("hierarchical_allreduce") is False
+        assert len(pm._bounds) == 4
+
+    def test_tuner_flips_hierarchical_when_it_scores_better(self):
+        """Simulated local_size=2 topology where the hierarchical ladder
+        makes steps faster: the converged parameters must have the knob ON
+        (VERDICT r2 item 5). Scores are synthesized step throughputs —
+        hierarchical=True worlds run 2x faster."""
+        import time as _time
+        from horovod_tpu.autotune.parameter_manager import ParameterManager
+
+        pm = ParameterManager(
+            warmup_samples=0, steps_per_sample=1, max_samples=14,
+            gp_noise=1e-3,
+            categorical=["hierarchical_allreduce"],
+            categorical_initial={"hierarchical_allreduce": False})
+        nbytes = 4 * 1024 * 1024
+        base_step = 0.02
+        clock = [0.0]
+        real = _time.perf_counter
+
+        def fake_clock():
+            return clock[0]
+
+        _time_pm = __import__(
+            "horovod_tpu.autotune.parameter_manager",
+            fromlist=["time"])
+        orig = _time_pm.time.perf_counter
+        _time_pm.time.perf_counter = fake_clock
+        try:
+            while pm.active:
+                # synthetic step: hierarchical halves the step time
+                hier = pm.categorical_value("hierarchical_allreduce")
+                clock[0] += base_step / (2.0 if hier else 1.0)
+                pm.step_mark(nbytes)
+        finally:
+            _time_pm.time.perf_counter = orig
+        assert not pm.active
+        assert pm.categorical_value("hierarchical_allreduce") is True, \
+            "tuner failed to adopt the faster hierarchical configuration"
+
+    def test_engine_applies_categorical_values(self):
+        """pm categorical values propagate into the live engine config."""
+        import horovod_tpu as hvd
+        from horovod_tpu.core.state import global_state
+        hvd.init()
+        st = global_state()
+        eng = st.engine
+
+        class FakePM:
+            active = False
+            fusion_threshold_bytes = 32 * 1024 * 1024
+            cycle_time_ms = 7.0
+
+            def tunes(self, name):
+                return name in ("hierarchical_allreduce",
+                                "hierarchical_allgather")
+
+            def categorical_value(self, name):
+                return True
+
+        old_pm = eng.parameter_manager
+        saved = (eng.config.hierarchical_allreduce,
+                 eng.config.hierarchical_allgather,
+                 eng.config.fusion_threshold_bytes,
+                 eng.config.cycle_time_ms)
+        try:
+            eng.parameter_manager = FakePM()
+            hs = hvd.grouped_allreduce_async(
+                [np.ones(8, np.float32)], name="catk")
+            for h in hs:
+                hvd.synchronize(h)
+            assert eng.config.hierarchical_allreduce is True
+            assert eng.config.hierarchical_allgather is True
+            assert eng.config.fusion_threshold_bytes == 32 * 1024 * 1024
+        finally:
+            eng.parameter_manager = old_pm
+            (eng.config.hierarchical_allreduce,
+             eng.config.hierarchical_allgather,
+             eng.config.fusion_threshold_bytes,
+             eng.config.cycle_time_ms) = saved

@@ -86,3 +86,50 @@ def test_mnist_under_tpurun_cli():
         env=env, timeout=420, capture_output=True, text=True)
     assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-2000:])
     assert "size=2" in r.stdout, r.stdout
+
+
+def test_api_docs_in_sync(tmp_path):
+    """docs/api.md must match what tools/gen_api_docs.py generates (the
+    docstring-driven reference the README links)."""
+    import shutil
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    committed = os.path.join(repo, "docs", "api.md")
+    with open(committed) as f:
+        before = f.read()
+    # run the generator against a scratch copy of the repo's docs dir
+    work = tmp_path / "repo"
+    work.mkdir()
+    (work / "docs").mkdir()
+    # the generator writes relative to its own location's parent/docs
+    (work / "tools").mkdir()
+    shutil.copy(os.path.join(repo, "tools", "gen_api_docs.py"),
+                work / "tools" / "gen_api_docs.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    subprocess.run([sys.executable, str(work / "tools" / "gen_api_docs.py")],
+                   check=True, env=env, capture_output=True)
+    with open(work / "docs" / "api.md") as f:
+        regenerated = f.read()
+    assert regenerated == before, \
+        "docs/api.md is stale — run python tools/gen_api_docs.py"
+
+
+def test_transformer_lm_example_spmd():
+    r = _run([os.path.join(EXAMPLES, "transformer_lm.py"),
+              "--mesh", "data=2", "--d-model", "32", "--n-layers", "1",
+              "--n-heads", "4", "--d-ff", "64", "--vocab", "128",
+              "--seq", "32", "--batch", "4", "--steps", "2"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "tokens_per_sec" in r.stdout, r.stdout
+
+
+def test_transformer_lm_example_eager():
+    r = _run([os.path.join(EXAMPLES, "transformer_lm.py"),
+              "--mode", "eager", "--d-model", "32", "--n-layers", "1",
+              "--n-heads", "4", "--d-ff", "64", "--vocab", "128",
+              "--seq", "32", "--batch", "4", "--steps", "2"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "tokens_per_sec" in r.stdout, r.stdout

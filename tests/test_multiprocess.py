@@ -187,3 +187,25 @@ def test_steady_state_eager_has_no_host_roundtrips():
         assert fetches == 0, f"host fetches during submission: {fetches}"
         assert v0 == 2.0          # s0: ones from both ranks
         assert v3 == 4.0          # s1: ones*2 from both ranks
+
+
+def _worker_sparse():
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+    r = hvd.rank()
+    # rank 0 touches rows {1, 3}; rank 1 touches rows {3, 5}
+    idx = np.array([1, 3]) if r == 0 else np.array([3, 5])
+    val = np.full((2, 2), float(r + 1), np.float32)
+    u, c = hvd.allreduce_sparse(idx, val, n_rows=8, average=False)
+    return u.tolist(), c[:, 0].tolist()
+
+
+@pytest.mark.integration
+def test_allreduce_sparse_two_process():
+    from horovod_tpu.runner import run
+    results = run(_worker_sparse, np=2, env=_mp_env())
+    for u, c in results:
+        assert u == [1, 3, 5], u
+        assert c == [1.0, 3.0, 2.0], c   # row 3 = 1 (r0) + 2 (r1)
