@@ -17,7 +17,9 @@ the TPU build owns (SURVEY §7 maps the reference's SIMD C++ to Pallas).
 
 from __future__ import annotations
 
+import functools
 import math
+import os
 
 import jax
 
@@ -32,6 +34,48 @@ def flash_available() -> bool:
         return True
     except Exception:
         return False
+
+
+def splash_available() -> bool:
+    """The newer splash-attention TPU kernel: measured 45% faster fwd+bwd
+    than the flash kernel at the flagship shape (6.3 vs 11.5 ms/layer,
+    B4 H16 T2048 D128 causal, v5e) with kv-block 2048."""
+    # default-on knob: only the known truthy tokens enable it, so a typo'd
+    # attempt to disable ("f", "disable", ...) fails safe to disabled
+    if os.environ.get("HOROVOD_SPLASH", "1").strip().lower() not in (
+            "1", "true", "yes", "on"):
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    try:
+        from jax.experimental.pallas.ops.tpu import splash_attention  # noqa
+        return True
+    except Exception:
+        return False
+
+
+@functools.lru_cache(maxsize=32)
+def _splash_kernel(h: int, t: int, causal: bool):
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk, splash_attention_mask as sm)
+    mk = sm.CausalMask if causal else (lambda s: sm.FullMask(s))
+    mask = sm.MultiHeadMask([mk((t, t)) for _ in range(h)])
+    bq = min(1024, t)
+    # kv block 2048 is the measured winner but must divide t (odd multiples
+    # of 1024, e.g. T=3072, take the 1024 block)
+    bkv = 2048 if t % 2048 == 0 else 1024
+    bd = min(1024, t)
+    bs = sk.BlockSizes(block_q=bq, block_kv=bkv, block_kv_compute=bkv,
+                       block_q_dkv=bd, block_kv_dkv=bd,
+                       block_kv_dkv_compute=bd, block_q_dq=bd,
+                       block_kv_dq=bd)
+    return sk.make_splash_mha(mask, head_shards=1, q_seq_shards=1,
+                              block_sizes=bs)
+
+
+def _splash_ok(shape) -> bool:
+    _, _, t, d = shape
+    return t >= 1024 and t % 1024 == 0 and d % 128 == 0
 
 
 def _block_sizes(t: int):
@@ -61,12 +105,16 @@ def flash_attention_local(q, k, v, causal: bool = True,
             q, k, v = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
         out = local_attention(q, k, v, causal=causal)
         return out.transpose(0, 2, 1, 3) if layout == "bhtk" else out
-    from jax.experimental.pallas.ops.tpu.flash_attention import (
-        flash_attention as _fa)
     scale = 1.0 / math.sqrt(q.shape[-1])
     if layout == "bthk":
         q, k, v = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-    bs = _block_sizes(q.shape[2])
-    out = _fa(q, k, v, causal=causal, sm_scale=scale,
-              **({"block_sizes": bs} if bs is not None else {}))
+    if splash_available() and _splash_ok(q.shape):
+        kernel = _splash_kernel(q.shape[1], q.shape[2], causal)
+        out = jax.vmap(kernel)((q * scale).astype(q.dtype), k, v)
+    else:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as _fa)
+        bs = _block_sizes(q.shape[2])
+        out = _fa(q, k, v, causal=causal, sm_scale=scale,
+                  **({"block_sizes": bs} if bs is not None else {}))
     return out.transpose(0, 2, 1, 3) if layout == "bthk" else out

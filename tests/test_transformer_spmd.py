@@ -222,3 +222,22 @@ def test_multislice_mesh_flagship_step():
                          NamedSharding(mesh, P("data", "seq")))
     p2, o2, loss = step(params, opt.init(params), tok, tok)
     assert np.isfinite(float(loss))
+
+
+def test_splash_gating_and_kernel_construction():
+    """The splash gating/block-size logic is pure Python (mask + BlockSizes
+    validation run in numpy) and must handle every T the gate admits —
+    including odd multiples of 1024 where kv-block 2048 doesn't divide T
+    (review finding: T=3072 crashed make_splash_mha)."""
+    from horovod_tpu.parallel.flash_attention import (_splash_kernel,
+                                                      _splash_ok)
+    assert _splash_ok((1, 4, 1024, 128))
+    assert _splash_ok((1, 4, 3072, 128))
+    assert not _splash_ok((1, 4, 512, 128))    # too short
+    assert not _splash_ok((1, 4, 1536, 128))   # not 1024-divisible
+    assert not _splash_ok((1, 4, 2048, 64))    # head dim not lane-aligned
+    for t in (1024, 2048, 3072):
+        for causal in (True, False):
+            k = _splash_kernel(2, t, causal)   # construction validates blocks
+            assert k is not None
+    _splash_kernel.cache_clear()
