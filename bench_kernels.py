@@ -70,6 +70,90 @@ def main():
             **({} if t_pl else {"pallas_error": err}),
         }))
 
+    _bench_attention()
+
+
+def _bench_attention():
+    """Attention kernel comparison (fwd+bwd, marginal scan timing) — the
+    measurement behind flash_attention_local's splash-first default. Only
+    meaningful on real TPU (off-TPU all paths fall back to the
+    materialized reference)."""
+    import math
+    from functools import partial
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from horovod_tpu.parallel.flash_attention import (flash_attention_local,
+                                                      splash_available)
+    from horovod_tpu.parallel.ring_attention import local_attention
+
+    B, H, T, D = 4, 16, 2048, 128
+    fl = 4 * B * H * T * T * D // 2 * 3  # causal fwd + 2x bwd
+
+    def marginal(att):
+        # distinct q/k/v: identical operands would let XLA exploit the
+        # symmetry of q·qᵀ in the materialized path
+        q0, k0, v0 = (jax.random.normal(jax.random.PRNGKey(i), (B, T, H, D),
+                                        jnp.bfloat16) for i in range(3))
+
+        def loss(q, k, v):
+            return jnp.sum(att(q, k, v).astype(jnp.float32) ** 2)
+
+        @partial(jax.jit, static_argnums=0)
+        def run(iters, q, k, v):
+            def body(c, _):
+                q, k, v, acc = c
+                # full backward (dq, dk, dv) so every kernel pays the same
+                # work — argnums=0 alone lets XLA dead-code-eliminate the
+                # dK/dV matmuls of the materialized path
+                l, (gq, gk, gv) = jax.value_and_grad(
+                    loss, argnums=(0, 1, 2))(q, k, v)
+                eps = jnp.bfloat16(1e-9)
+                return (q + gq * eps, k + gk * eps, v + gv * eps,
+                        acc + l), 0.
+            (q, k, v, acc), _ = lax.scan(
+                body, (q, k, v, jnp.zeros((), jnp.float32)), None,
+                length=iters)
+            return acc
+        for it in (4, 24):
+            float(np.asarray(run(it, q0, k0, v0)))
+        t0 = time.perf_counter()
+        float(np.asarray(run(4, q0, k0, v0)))
+        d1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(np.asarray(run(24, q0, k0, v0)))
+        d2 = time.perf_counter() - t0
+        return (d2 - d1) / 20
+
+    results = {}
+    if jax.default_backend() == "tpu":
+        import os
+        saved = os.environ.get("HOROVOD_SPLASH")
+        try:
+            results["materialized"] = marginal(
+                lambda q, k, v: local_attention(q, k, v, causal=True))
+            os.environ["HOROVOD_SPLASH"] = "0"
+            results["flash_tuned"] = marginal(
+                lambda q, k, v: flash_attention_local(q, k, v, causal=True))
+            os.environ["HOROVOD_SPLASH"] = "1"
+            if splash_available():
+                results["splash"] = marginal(
+                    lambda q, k, v: flash_attention_local(q, k, v,
+                                                          causal=True))
+        finally:
+            if saved is None:
+                os.environ.pop("HOROVOD_SPLASH", None)
+            else:
+                os.environ["HOROVOD_SPLASH"] = saved
+    print(json.dumps({
+        "bench": "attention_fwd_bwd", "shape": f"B{B} H{H} T{T} D{D} causal",
+        **{f"{k}_ms": round(v * 1e3, 2) for k, v in results.items()},
+        **{f"{k}_tflops": round(fl / v / 1e12, 1)
+           for k, v in results.items()},
+        "winner": (min(results, key=results.get) if results
+                   else "n/a (not on TPU)"),
+    }))
+
 
 if __name__ == "__main__":
     main()

@@ -15,6 +15,14 @@ dependency within a step).
 
 Use inside shard_map with the sequence axis manual; see
 ``horovod_tpu.models.transformer`` for the full integration.
+
+Known headroom (future work): the per-step block computation materializes
+the [B, H, Tq, Tk] score block; swapping in the splash/flash kernel per
+block (merging blocks via logsumexp residuals) would cut per-step memory
+to O(T_local) and reuse the tuned kernels of
+``parallel/flash_attention.py`` — it requires a hand-written backward for
+the residual merge (the pallas kernels don't expose lse cotangents), so
+it is staged behind the current, simpler formulation.
 """
 
 from __future__ import annotations

@@ -229,13 +229,18 @@ def test_splash_gating_and_kernel_construction():
     validation run in numpy) and must handle every T the gate admits —
     including odd multiples of 1024 where kv-block 2048 doesn't divide T
     (review finding: T=3072 crashed make_splash_mha)."""
+    import pytest
+    pytest.importorskip(
+        "jax.experimental.pallas.ops.tpu.splash_attention")
     from horovod_tpu.parallel.flash_attention import (_splash_kernel,
                                                       _splash_ok)
-    assert _splash_ok((1, 4, 1024, 128))
-    assert _splash_ok((1, 4, 3072, 128))
-    assert not _splash_ok((1, 4, 512, 128))    # too short
-    assert not _splash_ok((1, 4, 1536, 128))   # not 1024-divisible
-    assert not _splash_ok((1, 4, 2048, 64))    # head dim not lane-aligned
+    sq = (1, 4, 1024, 128)
+    assert _splash_ok(sq, sq)
+    assert _splash_ok((1, 4, 3072, 128), (1, 4, 3072, 128))
+    assert not _splash_ok((1, 4, 512, 128), (1, 4, 512, 128))   # too short
+    assert not _splash_ok((1, 4, 1536, 128), (1, 4, 1536, 128))  # not /1024
+    assert not _splash_ok((1, 4, 2048, 64), (1, 4, 2048, 64))   # d not 128
+    assert not _splash_ok(sq, (1, 4, 2048, 128))  # rectangular q/kv
     for t in (1024, 2048, 3072):
         for causal in (True, False):
             k = _splash_kernel(2, t, causal)   # construction validates blocks
