@@ -157,3 +157,38 @@ def test_broadcast_from_joined_root_errors():
     from horovod_tpu.runner import run
     results = run(_worker_joined_root_broadcast, np=2, env=_mp_env())
     assert results == ["raised", "raised"], results
+
+
+def _worker_ragged_grouped_overflow():
+    """24 tensors per grouped call: k > _JOIN_META_SLOTS (16), so the
+    advertisement spills into the deterministic overflow exchange — the
+    joined rank must reconstruct all 24 substitutes from head + overflow."""
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+    from horovod_tpu.core.engine import _JOIN_META_SLOTS
+    rank = hvd.rank()
+    n_tensors = _JOIN_META_SLOTS + 8
+    n_batches = 2 if rank == 0 else 4
+    sums = []
+    for b in range(n_batches):
+        outs = hvd.grouped_allreduce(
+            [np.ones((2, i + 1)) * (rank + 1) for i in range(n_tensors)],
+            name=f"ov{b}", op=hvd.Sum)
+        sums.append([float(np.asarray(o).ravel()[0]) for o in outs])
+    last = hvd.join()
+    return (sums, last, n_tensors)
+
+
+@pytest.mark.integration
+def test_ragged_grouped_metadata_overflow():
+    from horovod_tpu.runner import run
+    results = run(_worker_ragged_grouped_overflow, np=2, env=_mp_env())
+    (s0, last0, n), (s1, last1, _) = results
+    assert all(v == 3.0 for batch in s0 for v in batch), s0[:1]
+    assert all(v == 3.0 for batch in s1[:2] for v in batch)
+    # rank 0 joined: batches 2-3 see only rank 1's ones
+    assert all(v == 2.0 for batch in s1[2:] for v in batch), s1[2:][:1]
+    assert len(s1[0]) == n
+    assert last0 == last1 == 1
