@@ -66,6 +66,12 @@ HOROVOD_TPU_SHUTDOWN_TIMEOUT = "HOROVOD_TPU_SHUTDOWN_TIMEOUT"
 HOROVOD_TPU_SHUTDOWN_ORDER_TIMEOUT = "HOROVOD_TPU_SHUTDOWN_ORDER_TIMEOUT"
 HOROVOD_TPU_DEBUG_CONSISTENCY = "HOROVOD_TPU_DEBUG_CONSISTENCY"
 HOROVOD_TPU_PLATFORM = "HOROVOD_TPU_PLATFORM"                 # cpu|tpu override (tests)
+# steady-state metadata cache (the ResponseCache role for allgather sizes /
+# alltoall splits, response_cache.h:45-102): after WARMUP identical blocking
+# exchanges per name, the exchange goes fire-and-forget with a deferred
+# consistency check at extract time; =0 disables (always block)
+HOROVOD_TPU_META_CACHE = "HOROVOD_TPU_META_CACHE"
+HOROVOD_TPU_META_CACHE_WARMUP = "HOROVOD_TPU_META_CACHE_WARMUP"
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # operations.cc:432
 DEFAULT_CYCLE_TIME_MS = 5.0                        # operations.cc:440
@@ -124,6 +130,8 @@ class Config:
     debug_consistency: bool = False
     join_enabled: bool = True
     elastic: bool = False
+    meta_cache: bool = True
+    meta_cache_warmup: int = 2
     extra: dict = field(default_factory=dict)
 
     @classmethod
@@ -152,4 +160,6 @@ class Config:
             debug_consistency=_get_bool(HOROVOD_TPU_DEBUG_CONSISTENCY),
             join_enabled=not _get_bool(HOROVOD_JOIN_DISABLE),
             elastic=_get_bool(HOROVOD_ELASTIC),
+            meta_cache=_get_bool(HOROVOD_TPU_META_CACHE, True),
+            meta_cache_warmup=_get_int(HOROVOD_TPU_META_CACHE_WARMUP, 2),
         )

@@ -27,6 +27,24 @@ from .worker import notification_manager
 _LOG = logging.getLogger("horovod_tpu.elastic")
 
 
+def _recoverable_errors():
+    """Exception classes the run-loop treats as a collective failure.
+
+    The async eager hot path (DistributedEagerOptimizer) never blocks in
+    engine code — a peer crash first surfaces wherever the USER next
+    fetches a value (e.g. ``np.asarray(loss)``), as a raw XLA runtime
+    error that no ``_translate_failure`` wrapper saw. Catching JAX's
+    runtime error here keeps elastic recovery working for dataflow-chained
+    steps (and for failures inside user jit code generally)."""
+    errs = [HorovodInternalError]
+    try:
+        import jax
+        errs.append(jax.errors.JaxRuntimeError)
+    except Exception:
+        pass
+    return tuple(errs)
+
+
 def _reset():
     import horovod_tpu as hvd
     hvd.shutdown()
@@ -68,7 +86,7 @@ def run_fn(func, reset):
                     state.sync()
                 try:
                     return func(state, *args, **kwargs)
-                except HorovodInternalError:
+                except _recoverable_errors():
                     _LOG.info("collective failure; restoring last committed "
                               "state and re-initializing")
                     state.restore()

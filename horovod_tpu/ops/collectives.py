@@ -302,6 +302,25 @@ def build_broadcast(mesh: Mesh, axis: str, root_rank: int):
     return jax.jit(fn)
 
 
+def build_broadcast_flagged(mesh: Mesh, axis: str, root_rank: int):
+    """Broadcast that also returns the ROOT's active bit, in the same launch.
+
+    Join-protocol support without a blocking pre-dispatch check (VERDICT r3
+    item 2): a joined root dispatches its zero substitute with active=0; the
+    receivers' extract reads the flag and raises instead of silently
+    consuming zeros. The collective always matches (nothing hangs), and the
+    active path pays no host round-trip at submission — the reference gets
+    the same guarantee from its blocking negotiation phase
+    (operations.cc:1004-1040 joined-root error)."""
+    def body(x, a):  # x: (1, *s), a: (1,)
+        return (broadcast_p(x[0], axis, root_rank),
+                broadcast_p(a[0], axis, root_rank))
+
+    fn = _shmap(body, mesh, axis, in_specs=(P(axis), P(axis)),
+                out_specs=(P(), P()))
+    return jax.jit(fn)
+
+
 def build_alltoall(mesh: Mesh, axis: str):
     """Stacked equal-split alltoall: (n, d0, *s) -> (n, d0, *s), d0 % n == 0."""
     def body(x):
@@ -384,6 +403,31 @@ def build_fused_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
     fn = _shmap(body, mesh, axis, in_specs=P(axis),
                 out_specs=tuple(P() for _ in shapes),
                 check_vma=(local_size <= 1))
+    return jax.jit(fn)
+
+
+def build_fused_broadcast(mesh: Mesh, axis: str, root_rank: int, shapes,
+                          dtype):
+    """One-launch fused bucket broadcast: the stacked packed buffer
+    (n, total) plus the active bit -> one stacked (*shape_i) array per
+    bucket member and the root's active flag, all from a single launch
+    (the fusion-buffer treatment applied to broadcast_parameters' init
+    storm — N leaves, one collective per dtype bucket, ONE flag read)."""
+    sizes = [math.prod(s) for s in shapes]
+
+    def body(x, a):  # x: (1, total), a: (1, 1)
+        out = broadcast_p(x[0], axis, root_rank)
+        flag = broadcast_p(a[0], axis, root_rank)
+        pieces = []
+        offset = 0
+        for shape, size in zip(shapes, sizes):
+            pieces.append(
+                lax.dynamic_slice_in_dim(out, offset, size).reshape(shape))
+            offset += size
+        return tuple(pieces) + (flag,)
+
+    fn = _shmap(body, mesh, axis, in_specs=(P(axis), P(axis)),
+                out_specs=tuple(P() for _ in shapes) + (P(),))
     return jax.jit(fn)
 
 

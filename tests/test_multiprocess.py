@@ -189,6 +189,351 @@ def test_steady_state_eager_has_no_host_roundtrips():
         assert v3 == 4.0          # s1: ones*2 from both ranks
 
 
+def _worker_steady_state_sized_ops():
+    """VERDICT r3 item 2: steady-state allgather (uneven), alltoall (uneven
+    splits) and broadcast must stop paying a blocking size exchange per call
+    once the per-name cache goes hot; the consistency check is deferred to
+    extract time (deferred_meta_checks)."""
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+    eng = hvd._engine()
+    rank, size = hvd.rank(), hvd.size()
+    d0 = rank + 1                       # uneven allgather rows
+    splits = [rank + 1] * size          # uneven alltoall splits
+
+    def one_round():
+        g = np.asarray(hvd.allgather(
+            np.full((d0, 2), float(rank), np.float32), name="ss.ag"))
+        recv, counts = hvd.alltoall(
+            np.full(((rank + 1) * size, 1), float(rank), np.float32),
+            splits=splits, name="ss.a2a")
+        b = np.asarray(hvd.broadcast(np.array([rank + 7.0]), root_rank=0,
+                                     name="ss.bc"))
+        return g, np.asarray(recv), np.asarray(counts), b
+
+    for _ in range(3):                  # warmup: cache goes hot at streak 2
+        one_round()
+    f0, d0c = eng.host_fetches, eng.deferred_meta_checks
+    rounds = [one_round() for _ in range(10)]
+    fetches = eng.host_fetches - f0
+    checks = eng.deferred_meta_checks - d0c
+    g, recv, counts, b = rounds[-1]
+    return {"rank": rank, "fetches": fetches, "checks": checks,
+            "g_rows": int(g.shape[0]), "counts": counts[:, 0].tolist()
+            if counts.ndim > 1 else counts.tolist(),
+            "recv_rows": int(recv.shape[0]), "b": float(b[0])}
+
+
+@pytest.mark.integration
+def test_steady_state_sized_ops_no_host_roundtrips():
+    """Allgather/alltoall/broadcast in steady state: zero blocking metadata
+    fetches; the deferred extract-time checks run instead and the results
+    stay correct."""
+    from horovod_tpu.runner import run
+    results = run(_worker_steady_state_sized_ops, np=2, env=_mp_env())
+    for r in results:
+        assert r["fetches"] == 0, r
+        assert r["checks"] == 20, r      # 10 allgather + 10 alltoall rounds
+        assert r["g_rows"] == 3, r       # 1 + 2 uneven rows
+        assert r["counts"] == [1, 2], r  # 1 row from rank0, 2 from rank1
+        assert r["recv_rows"] == 3, r
+        assert r["b"] == 7.0, r
+
+
+def _worker_meta_cache_mismatch():
+    """When a rank's sizes change after the per-name cache went hot, every
+    rank must RAISE (never hang, never return garbage): hot peers via the
+    deferred advertisement check, the changed rank via its stale-local
+    marker — and the op sequence stays aligned so the next, consistent op
+    succeeds after renegotiation."""
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+    from horovod_tpu.common.exceptions import HorovodInternalError
+
+    rank = hvd.rank()
+    for _ in range(3):   # cache hot at streak 2
+        hvd.allgather(np.ones((1, 2), np.float32) * rank, name="mm.ag")
+    d0 = 2 if rank == 1 else 1   # rank 1's row count changes
+    h = hvd._engine().allgather(np.ones((d0, 2), np.float32), name="mm.ag")
+    raised = False
+    try:
+        h.synchronize()
+    except HorovodInternalError:
+        raised = True
+    # after the mismatch the entry is invalidated -> blocking renegotiation
+    out = np.asarray(hvd.allgather(np.ones((d0, 2), np.float32) * (rank + 1),
+                                   name="mm.ag"))
+    return {"rank": rank, "raised": raised, "rows": int(out.shape[0])}
+
+
+@pytest.mark.integration
+def test_meta_cache_mismatch_raises_everywhere():
+    from horovod_tpu.runner import run
+    results = run(_worker_meta_cache_mismatch, np=2, env=_mp_env())
+    for r in results:
+        assert r["raised"], r
+        assert r["rows"] == 3, r      # 1 + 2 rows gathered correctly after
+
+
+def _worker_join_allgather_hot_cache():
+    """A join substitute must replay a hot-cached UNEVEN allgather with the
+    joined rank's own previously-advertised size: same collective
+    sequence, same program shapes, hot peers' deferred check untouched —
+    no hang, no spurious mismatch error (code-review r4 finding)."""
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+
+    rank = hvd.rank()
+    d0 = rank + 2   # rank0: 2 rows, rank1: 3 rows — uneven but stable
+    for _ in range(3):   # hot at streak 2
+        hvd.allgather(np.full((d0, 2), float(rank), np.float32), name="ju.ag")
+    if rank == 0:
+        # one more hot allgather while rank 1 sits in join()
+        g = np.asarray(hvd.allgather(np.full((d0, 2), 7.0, np.float32),
+                                     name="ju.ag"))
+        last = hvd.join()
+        return {"rank": 0, "rows": int(g.shape[0]),
+                "head_ok": bool((g[:2] == 7.0).all()),
+                "tail_zero": bool((g[2:] == 0.0).all()), "last": last}
+    last = hvd.join()
+    return {"rank": 1, "last": last}
+
+
+@pytest.mark.integration
+def test_join_substitute_respects_hot_size_cache():
+    from horovod_tpu.runner import run
+    r0, r1 = run(_worker_join_allgather_hot_cache, np=2, env=_mp_env())
+    assert r0["rows"] == 5, r0            # 2 live + 3 zero-substitute rows
+    assert r0["head_ok"] and r0["tail_zero"], r0
+    assert r0["last"] == r1["last"] == 0  # rank 0 joined last
+
+
+def _worker_chained_optimizer():
+    """VERDICT r3 item 1a: the eager optimizer chains the update onto the
+    reduced gradient arrays with ZERO host blocks — dataflow is the
+    synchronization. host_blocks counts Handle.synchronize waits;
+    host_fetches counts blocking metadata read-backs."""
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+    import horovod_tpu as hvd
+    from horovod_tpu.optimizer import DistributedEagerOptimizer
+
+    eng = hvd._engine()
+    rank = hvd.rank()
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    opt = DistributedEagerOptimizer(optax.sgd(0.1))
+    state = opt.init(params)
+
+    def loss(p, x):
+        return jnp.sum((x @ p["w"] + p["b"]) ** 2)
+
+    grad_fn = jax.jit(jax.grad(loss))
+    x = jnp.ones((2, 4)) * (rank + 1)
+    # warmup: compile grad/pack/reduce/apply programs
+    for _ in range(3):
+        g = grad_fn(params, x)
+        params, state = opt.update_and_apply(g, state, params)
+    jax.block_until_ready(params)
+    blocks0, fetches0 = eng.host_blocks, eng.host_fetches
+    for _ in range(10):
+        g = grad_fn(params, x)
+        params, state = opt.update_and_apply(g, state, params)
+    blocks = eng.host_blocks - blocks0
+    fetches = eng.host_fetches - fetches0
+    jax.block_until_ready(params)
+    return {"rank": rank, "host_blocks": blocks, "host_fetches": fetches,
+            "w": np.asarray(params["w"]).tolist(),
+            "finite": bool(np.isfinite(np.asarray(params["w"])).all())}
+
+
+@pytest.mark.integration
+def test_chained_eager_optimizer_no_host_blocks():
+    from horovod_tpu.runner import run
+    r0, r1 = run(_worker_chained_optimizer, np=2, env=_mp_env())
+    for r in (r0, r1):
+        assert r["host_blocks"] == 0, r
+        assert r["host_fetches"] == 0, r
+        assert r["finite"], r
+    # averaged gradients -> replicas stay in lockstep
+    assert r0["w"] == r1["w"]
+
+
+def _worker_throughput():
+    """VERDICT r3 item 1b: eager-vs-SPMD throughput where dispatch is cheap
+    (CPU backend, ~100us per dispatch) — separates framework cost from the
+    tunneled test rig's 10-80ms dispatch overhead. Same model, same world."""
+    import time
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax import shard_map
+    import horovod_tpu as hvd
+    from horovod_tpu import optimizer as hvd_opt
+    from horovod_tpu.optimizer import DistributedEagerOptimizer
+    from horovod_tpu.parallel.mesh import WORLD_AXIS
+
+    eng = hvd._engine()
+    size, rank = hvd.size(), hvd.rank()
+    D, H, B = 256, 1024, 256
+    rng = np.random.RandomState(rank)
+    x = jnp.asarray(rng.rand(B, D).astype(np.float32))
+    y = jnp.asarray(rng.rand(B, 1).astype(np.float32))
+    params = {
+        "w1": jnp.asarray(np.random.RandomState(0).randn(D, H) * 0.05,
+                          jnp.float32),
+        "w2": jnp.asarray(np.random.RandomState(1).randn(H, H) * 0.05,
+                          jnp.float32),
+        "w3": jnp.asarray(np.random.RandomState(2).randn(H, 1) * 0.05,
+                          jnp.float32),
+    }
+
+    def loss(p, x, y):
+        h = jnp.tanh(x @ p["w1"])
+        h = jnp.tanh(h @ p["w2"])
+        return jnp.mean((h @ p["w3"] - y) ** 2)
+
+    iters = 30
+
+    # ---- eager path: jitted grad -> engine grouped_allreduce -> chained
+    # jitted apply (3 dispatches/step, zero host blocks)
+    grad_fn = jax.jit(jax.grad(loss))
+    opt = DistributedEagerOptimizer(optax.sgd(0.01))
+    ep, es = jax.tree_util.tree_map(lambda a: a, params), None
+    es = opt.init(ep)
+    for _ in range(3):
+        ep, es = opt.update_and_apply(grad_fn(ep, x, y), es, ep)
+    jax.block_until_ready(ep)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ep, es = opt.update_and_apply(grad_fn(ep, x, y), es, ep)
+    jax.block_until_ready(ep)
+    eager_dt = (time.perf_counter() - t0) / iters
+
+    # ---- SPMD path: one jitted shard_map step over the group mesh with the
+    # framework's distributed optax wrapper (psum inside the program)
+    mesh = eng.backend.group_mesh
+    dist = hvd_opt.distributed(optax.sgd(0.01), axis_name=WORLD_AXIS,
+                               op=hvd.Average)
+
+    def body(p, s, xg, yg):
+        g = jax.grad(loss)(p, xg[0], yg[0])
+        u, s = dist.update(g, s, p)
+        return optax.apply_updates(p, u), s
+
+    step = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(), P(WORLD_AXIS), P(WORLD_AXIS)),
+        out_specs=(P(), P())))
+    rep = NamedSharding(mesh, P())
+    sp = jax.device_put(params, rep)
+    ss = jax.device_put(dist.init(params), rep)
+    xg, yg = eng.backend.to_global(x), eng.backend.to_global(y)
+    for _ in range(3):
+        sp, ss = step(sp, ss, xg, yg)
+    jax.block_until_ready(sp)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        sp, ss = step(sp, ss, xg, yg)
+    jax.block_until_ready(sp)
+    spmd_dt = (time.perf_counter() - t0) / iters
+    return {"rank": rank, "eager_ms": eager_dt * 1e3,
+            "spmd_ms": spmd_dt * 1e3,
+            "ratio": spmd_dt / eager_dt}
+
+
+@pytest.mark.integration
+def test_eager_vs_spmd_cpu_throughput():
+    """VERDICT r3 item 1 'done' bar: eager >= 50% of SPMD throughput on a
+    2-process CPU bench (framework cost measured off-tunnel)."""
+    from horovod_tpu.runner import run
+    results = run(_worker_throughput, np=2, env=_mp_env())
+    for r in results:
+        assert r["ratio"] >= 0.5, (
+            f"eager path is {r['ratio']:.1%} of SPMD throughput "
+            f"(eager {r['eager_ms']:.2f} ms vs spmd {r['spmd_ms']:.2f} ms); "
+            f"target >=50%: {r}")
+
+
+def _worker_sparse_optimizer():
+    """VERDICT r3 item 9: an embedding model trained through
+    sparse_rows-marked gradients must (a) match the dense-allreduce path
+    numerically and (b) put far fewer bytes on the wire (counted at
+    engine enqueue), with the duplicate-combine jitted (no host NumPy)."""
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+    import horovod_tpu as hvd
+    from horovod_tpu.optimizer import DistributedEagerOptimizer
+
+    eng = hvd._engine()
+    rank = hvd.rank()
+    V, Dm, B = 1024, 16, 8
+    tok = jnp.asarray((np.random.RandomState(rank).randint(0, V, B))
+                      .astype(np.int32))
+    tgt = jnp.asarray(np.random.RandomState(100 + rank).rand(B, Dm)
+                      .astype(np.float32))
+
+    def loss(p, tok, tgt):
+        return jnp.mean((p["embed"][tok] @ p["proj"] - tgt) ** 2)
+
+    grad_fn = jax.jit(jax.grad(loss))
+
+    def train(sparse_rows, steps=4):
+        params = {"embed": jnp.ones((V, Dm)) * 0.1,
+                  "proj": jnp.eye(Dm)}
+        opt = DistributedEagerOptimizer(optax.sgd(0.5),
+                                        sparse_rows=sparse_rows)
+        st = opt.init(params)
+        nbytes = [0]
+        orig = eng.on_enqueue
+
+        def count(name, kind, nb):
+            nbytes[0] += nb
+            if orig:
+                orig(name, kind, nb)
+
+        eng.on_enqueue = count
+        try:
+            for _ in range(steps):
+                g = grad_fn(params, tok, tgt)
+                params, st = opt.update_and_apply(g, st, params)
+            jax.block_until_ready(params)
+        finally:
+            eng.on_enqueue = orig
+        return params, nbytes[0]
+
+    dense_params, dense_bytes = train(None)
+    sparse_params, sparse_bytes = train({"embed": B})
+    err = float(jnp.max(jnp.abs(dense_params["embed"]
+                                - sparse_params["embed"])))
+    return {"rank": rank, "dense_bytes": dense_bytes,
+            "sparse_bytes": sparse_bytes, "max_err": err}
+
+
+@pytest.mark.integration
+def test_sparse_optimizer_beats_dense_on_wire_bytes():
+    from horovod_tpu.runner import run
+    results = run(_worker_sparse_optimizer, np=2, env=_mp_env())
+    for r in results:
+        assert r["max_err"] < 1e-6, r
+        # embed leaf: dense ships V*Dm floats/step; sparse ships B*(Dm+1)
+        assert r["sparse_bytes"] < r["dense_bytes"] / 5, r
+
+
 def _worker_sparse():
     import numpy as np
     import jax
