@@ -109,13 +109,20 @@ def _block_sizes(t: int):
 def flash_attention_local(q, k, v, causal: bool = True,
                           layout: str = "bthk"):
     """Attention via the Pallas TPU flash kernel, with the materialized
-    fallback off-TPU. ``layout`` is the layout of q/k/v (and the result):
+    fallback off-TPU (and for block-unaligned sequence lengths). ``layout``
+    is the layout of q/k/v (and the result):
     "bthk" ([B, T, H, D], the framework's default) or "bhtk" ([B, H, T, D],
     the kernel's native layout — callers that can project straight into it
     skip the transposes)."""
     if layout not in ("bthk", "bhtk"):
         raise ValueError(f"unknown attention layout {layout!r}")
-    if not flash_available():
+    # The Pallas flash kernel's _verify_block requires both sequence lengths
+    # divisible by its block sizes (128 minimum); unaligned lengths
+    # (ViT-B/16 at 224px -> 197 tokens, ViT_Tiny/32 -> 17) take the
+    # materialized fallback instead of crashing on TPU (ADVICE r3 medium).
+    kernel_t = q.shape[1] if layout == "bthk" else q.shape[2]
+    kv_t = k.shape[1] if layout == "bthk" else k.shape[2]
+    if not flash_available() or kernel_t % 128 or kv_t % 128:
         if layout == "bhtk":
             q, k, v = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
         out = local_attention(q, k, v, causal=causal)

@@ -38,6 +38,48 @@ def test_ring_matches_local(causal):
     np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
 
 
+def test_ring_matches_local_bf16():
+    """VERDICT r3 item 3 'done' bar: flash-inner-kernel ring attention
+    matches the materialized reference at bf16 tolerance on the virtual
+    mesh."""
+    mesh = _mesh_seq(4)
+    B, T, H, D = 2, 32, 2, 16
+    rng = np.random.RandomState(2)
+    q = (rng.randn(B, T, H, D) * 0.3).astype(jnp.bfloat16)
+    k = (rng.randn(B, T, H, D) * 0.3).astype(jnp.bfloat16)
+    v = rng.randn(B, T, H, D).astype(jnp.bfloat16)
+    ref = np.asarray(local_attention(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), causal=True)
+                     .astype(jnp.float32))
+    fn = jax.jit(jax.shard_map(
+        lambda q, k, v: ring_attention_p(q, k, v, "seq", 4, causal=True),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3, out_specs=P(None, "seq")))
+    sh = NamedSharding(mesh, P(None, "seq"))
+    out = np.asarray(fn(jax.device_put(q, sh), jax.device_put(k, sh),
+                        jax.device_put(v, sh)).astype(jnp.float32))
+    np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+
+
+def test_ring_attention_no_full_score_block():
+    """VERDICT r3 item 3: the per-ring-step kernel must NOT materialize the
+    [.., T_local, T_local] score block — the compiled program may only hold
+    [.., T_local, chunk] slabs. Asserted on the optimized HLO of a
+    T_local=2048 forward (chunk=512), where a materialized block would
+    appear as a 2048x2048 buffer."""
+    mesh = _mesh_seq(4)
+    B, T_local, H, D = 1, 2048, 1, 64
+    T = 4 * T_local
+    fn = jax.jit(jax.shard_map(
+        lambda q, k, v: ring_attention_p(q, k, v, "seq", 4, causal=True),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3, out_specs=P(None, "seq")))
+    sh = NamedSharding(mesh, P(None, "seq"))
+    arg = jax.ShapeDtypeStruct((B, T, H, D), jnp.bfloat16, sharding=sh)
+    txt = fn.lower(arg, arg, arg).compile().as_text()
+    assert "2048,2048" not in txt, \
+        "compiled ring attention materializes a T_local x T_local buffer"
+    assert "2048,512" in txt or "512,2048" in txt  # the chunked slab exists
+
+
 def test_ring_attention_grad_matches():
     mesh = _mesh_seq(4)
     B, T, H, D = 1, 8, 2, 4
