@@ -59,6 +59,14 @@ class TransformerConfig:
     n_experts: int = 8
     moe_capacity_factor: float = 2.0
     moe_aux_weight: float = 0.01
+    # Rematerialization (gradient checkpointing): trades recompute FLOPs for
+    # activation memory — the lever past the B=4 cliff on 16 GB HBM
+    # (VERDICT r3 item 4). "none" saves every activation; "block"
+    # jax.checkpoint's each transformer layer (backward recomputes the layer
+    # from its input — activation memory drops from O(L·B·T·(D+F)) to
+    # O(B·T·D) per live layer); "attention" remats only the attention
+    # sub-block (cheaper recompute, smaller saving).
+    remat: str = "none"
 
     @property
     def head_dim(self) -> int:
@@ -158,14 +166,11 @@ def _forward(params, tokens, cfg: TransformerConfig,
     flash = (cfg.attention == "flash"
              and (seq_size is None or seq_size <= 1))
 
-    def layer(carry, lp):
-        h, aux_sum = carry
-        # Attention
-        x = _rmsnorm(h, lp["ln1"])
+    def attn_block(x, wq, wk, wv, wo):
         qkv_eq = "btd,dhk->bhtk" if flash else "btd,dhk->bthk"
-        q = jnp.einsum(qkv_eq, x, lp["wq"].astype(dt))
-        k = jnp.einsum(qkv_eq, x, lp["wk"].astype(dt))
-        v = jnp.einsum(qkv_eq, x, lp["wv"].astype(dt))
+        q = jnp.einsum(qkv_eq, x, wq.astype(dt))
+        k = jnp.einsum(qkv_eq, x, wk.astype(dt))
+        v = jnp.einsum(qkv_eq, x, wv.astype(dt))
         if seq_size is not None and seq_size > 1:
             attn_p = (ulysses_attention_p if cfg.attention == "ulysses"
                       else ring_attention_p)
@@ -176,10 +181,22 @@ def _forward(params, tokens, cfg: TransformerConfig,
         else:
             att = local_attention(q, k, v, causal=causal)
         out = jnp.einsum("bhtk,hkd->btd" if flash else "bthk,hkd->btd",
-                         att, lp["wo"].astype(dt))
+                         att, wo.astype(dt))
         if tensor_size is not None:
             out = lax.psum(out, TENSOR_AXIS)
-        h = h + out
+        return out
+
+    if cfg.remat == "attention":
+        # backward recomputes q/k/v projections + attention from the normed
+        # input instead of saving them (prevent_cse is unnecessary inside
+        # scan, and disabling it lets XLA fuse the recompute cleanly)
+        attn_block = jax.checkpoint(attn_block, prevent_cse=False)
+
+    def layer(carry, lp):
+        h, aux_sum = carry
+        # Attention
+        x = _rmsnorm(h, lp["ln1"])
+        h = h + attn_block(x, lp["wq"], lp["wk"], lp["wv"], lp["wo"])
         # FFN: dense (TP over hidden dim) or MoE (EP over the same axis)
         x = _rmsnorm(h, lp["ln2"])
         if cfg.use_moe:
@@ -223,6 +240,15 @@ def _forward(params, tokens, cfg: TransformerConfig,
                 out = lax.psum(out, TENSOR_AXIS)
         h = h + out
         return (h, aux_sum), None
+
+    if cfg.remat == "block":
+        # each scanned layer recomputes from its carry in backward: live
+        # activations shrink from every layer's intermediates to one
+        # layer's input per step (VERDICT r3 item 4 — the B>4 OOM lever)
+        layer = jax.checkpoint(layer, prevent_cse=False)
+    elif cfg.remat not in ("none", "attention"):
+        raise ValueError(f"unknown remat mode {cfg.remat!r}; "
+                         f"expected 'none', 'block', or 'attention'")
 
     aux0 = jnp.zeros((), jnp.float32)
     if cfg.use_moe and tensor_size is not None:

@@ -246,3 +246,48 @@ def test_splash_gating_and_kernel_construction():
             k = _splash_kernel(2, t, causal)   # construction validates blocks
             assert k is not None
     _splash_kernel.cache_clear()
+
+
+def test_remat_matches_no_remat():
+    """VERDICT r3 item 4: remat changes memory, never numerics — loss and
+    grads under remat='block'/'attention' match remat='none' exactly (same
+    program modulo recompute), on the single-shard AND the SPMD path."""
+    params = tfm.init_params(jax.random.PRNGKey(3), CFG)
+    inputs, targets = _data(bsz=2, seq=16, seed=4)
+
+    def loss_of(cfg):
+        def f(p):
+            total, count, _aux = tfm._local_loss(
+                p, jnp.asarray(inputs), jnp.asarray(targets), cfg)
+            return total / count
+        return jax.jit(jax.value_and_grad(f))
+
+    base_l, base_g = loss_of(CFG)(params)
+    for mode in ("block", "attention"):
+        cfg = dataclasses.replace(CFG, remat=mode)
+        l, g = loss_of(cfg)(params)
+        np.testing.assert_allclose(float(l), float(base_l), rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(g),
+                        jax.tree_util.tree_leaves(base_g)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    # SPMD path with remat compiles and matches too (ring attention's custom
+    # VJP must survive jax.checkpoint's recompute)
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
+                (tfm.DATA_AXIS, tfm.SEQ_AXIS, tfm.TENSOR_AXIS))
+    tok_sh = NamedSharding(mesh, P(tfm.DATA_AXIS, tfm.SEQ_AXIS))
+    sp = tfm.shard_params(params, mesh, CFG)
+    gi = jax.device_put(inputs, tok_sh)
+    gt = jax.device_put(targets, tok_sh)
+    ref = float(jax.jit(tfm.make_spmd_loss(mesh, CFG))(sp, gi, gt))
+    cfg = dataclasses.replace(CFG, remat="block")
+    out = float(jax.jit(tfm.make_spmd_loss(mesh, cfg))(sp, gi, gt))
+    assert abs(out - ref) / abs(ref) < 1e-5, (out, ref)
+
+
+def test_remat_unknown_mode_raises():
+    cfg = dataclasses.replace(CFG, remat="everything")
+    params = tfm.init_params(jax.random.PRNGKey(0), CFG)
+    with pytest.raises(ValueError, match="remat"):
+        tfm.forward_block(params, jnp.zeros((1, 8), jnp.int32), cfg)
