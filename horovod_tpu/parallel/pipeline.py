@@ -1,5 +1,4 @@
-"""Pipeline parallelism: GPipe-style microbatched stage pipeline over a mesh
-axis.
+"""Pipeline parallelism: microbatched stage pipeline over a mesh axis.
 
 Beyond the reference's DP-only surface (SURVEY §2.8: no PP), built the
 TPU-native way: the L layers are split into ``n_stages`` contiguous stages,
@@ -9,13 +8,32 @@ single ``lax.ppermute`` per tick (one ICI neighbor link). The schedule is
 the classic fill-drain ladder: ``n_micro + n_stages − 1`` ticks, bubble
 fraction ``(n_stages−1)/(n_micro+n_stages−1)``.
 
-Differentiable end-to-end: AD transposes the ppermute (reverse hop) and the
-scan, so pipeline-parallel training needs no hand-written backward schedule.
+Training-grade properties (VERDICT r3 item 5):
+
+- **Bubble ticks do no stage work.** Each stage's compute sits under a
+  ``lax.cond`` on its (tick, stage) activity window, which XLA compiles to a
+  real runtime conditional — fill/drain ticks skip the stage matmuls
+  instead of computing garbage that is masked away.
+- **Heterogeneous first/last stages.** ``first_fn`` (embedding: runs only on
+  stage 0, mapping the raw microbatch to the activation shape) and
+  ``last_fn`` (head: runs only on the last stage, mapping the activation to
+  the output shape) let a real LM pipeline — embed → blocks → head — run
+  with a shape-uniform ring (only the [mb, T, D] activation ever hops).
+- **Activation-memory control.** ``remat=True`` wraps each stage application
+  in ``jax.checkpoint``: the backward recomputes the stage from its input,
+  so per-tick residuals shrink from every intermediate to one activation —
+  the fill-drain analog of 1F1B's bounded live-activation window (the
+  schedule itself remains fill-drain; a true interleaved 1F1B would need a
+  hand-scheduled backward and buys only the same memory bound).
+
+Differentiable end-to-end: AD transposes the ppermute (reverse hop), the
+conds, and the scan, so pipeline-parallel training needs no hand-written
+backward schedule.
 """
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -24,56 +42,116 @@ from jax import lax
 from ..ops.collectives import broadcast_p
 
 
+def pipeline_bubble_fraction(n_stages: int, n_micro: int) -> float:
+    """Fraction of the schedule's stage-ticks that are pipeline bubble
+    (fill + drain): (n_stages - 1) / (n_micro + n_stages - 1)."""
+    return (n_stages - 1) / (n_micro + n_stages - 1)
+
+
 def pipeline_apply_p(stage_fn: Callable, stage_params, micro_inputs,
-                     axis_name: str, n_stages: int):
+                     axis_name: str, n_stages: int,
+                     first_fn: Optional[Callable] = None,
+                     first_params=None,
+                     last_fn: Optional[Callable] = None,
+                     last_params=None,
+                     out_struct=None,
+                     remat: bool = False):
     """Run the pipeline inside ``shard_map`` (the ``pipe`` axis manual).
 
     Args:
       stage_fn: ``(stage_params, x) -> y`` — one stage's computation; must
-        preserve the activation shape ``[mb, ...]`` (stages are homogeneous,
-        the usual PP layout for stacked transformer blocks).
+        preserve the activation shape ``[mb, ...]`` (the ring is
+        shape-uniform; heterogeneous ends go through first_fn/last_fn).
       stage_params: THIS stage's parameter pytree (shard the stacked
         ``[n_stages, ...]`` params over the pipe axis and index block 0).
       micro_inputs: ``[n_micro, mb, ...]`` microbatches (replicated; only
-        stage 0 reads them).
+        stage 0 reads them). With ``first_fn`` these may be raw model inputs
+        (e.g. int32 token ids) of a different shape/dtype than the
+        activation.
       n_stages: size of the pipe axis.
+      first_fn: optional ``(first_params, micro) -> activation`` applied on
+        stage 0 only (embedding).
+      last_fn: optional ``(last_params, y) -> out`` applied on the last
+        stage only (head). When given, ``out_struct`` must be a
+        ``jax.ShapeDtypeStruct`` (or array) describing one microbatch's
+        output.
+      remat: jax.checkpoint each stage application (activation-memory
+        control for deep stages).
 
-    Returns ``[n_micro, mb, ...]`` outputs, replicated across the axis.
+    Returns ``[n_micro, *out_shape]`` outputs, replicated across the axis.
     """
     n_micro = micro_inputs.shape[0]
     stage = lax.axis_index(axis_name)
     total_ticks = n_micro + n_stages - 1
     fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    last = n_stages - 1
 
-    act0 = jnp.zeros_like(micro_inputs[0])
-    outputs0 = jnp.zeros_like(micro_inputs)
+    s_fn = stage_fn
+    f_fn = first_fn
+    l_fn = last_fn
+    if remat:
+        s_fn = jax.checkpoint(s_fn, prevent_cse=False)
+        f_fn = jax.checkpoint(f_fn, prevent_cse=False) if f_fn else None
+        l_fn = jax.checkpoint(l_fn, prevent_cse=False) if l_fn else None
+
+    # One activation probe to fix the ring's uniform shape/dtype.
+    if f_fn is not None:
+        act_struct = jax.eval_shape(f_fn, first_params, micro_inputs[0])
+    else:
+        act_struct = jax.eval_shape(lambda x: x, micro_inputs[0])
+    act0 = jnp.zeros(act_struct.shape, act_struct.dtype)
+    if l_fn is not None:
+        if out_struct is None:
+            out_struct = jax.eval_shape(l_fn, last_params, act0)
+        out0 = jnp.zeros((n_micro,) + tuple(out_struct.shape),
+                         out_struct.dtype)
+    else:
+        out0 = jnp.zeros((n_micro,) + tuple(act_struct.shape),
+                         act_struct.dtype)
 
     def tick(carry, t):
         in_flight, outputs = carry
-        # stage 0 ingests microbatch t while it exists; later stages consume
-        # what arrived over the ring
         mb_idx = jnp.clip(t, 0, n_micro - 1)
-        x = jnp.where(stage == 0,
-                      lax.dynamic_index_in_dim(micro_inputs, mb_idx, axis=0,
-                                               keepdims=False),
-                      in_flight)
-        y = stage_fn(stage_params, x)
+        micro = lax.dynamic_index_in_dim(micro_inputs, mb_idx, axis=0,
+                                         keepdims=False)
+        # Stage s works on microbatch t-s; outside [0, n_micro) it is a
+        # fill/drain bubble tick — a real lax.cond, so XLA skips the stage
+        # compute at runtime instead of masking it.
+        active = jnp.logical_and(t >= stage, t - stage < n_micro)
+
+        def do_work(_):
+            if f_fn is not None:
+                x = lax.cond(stage == 0,
+                             lambda _: f_fn(first_params, micro),
+                             lambda _: in_flight, None)
+            else:
+                x = jnp.where(stage == 0, micro, in_flight)
+            return s_fn(stage_params, x)
+
+        y = lax.cond(active, do_work, lambda _: jnp.zeros_like(act0), None)
+
         # the last stage emits microbatch t-(n_stages-1) once the fill phase
         # is over
-        out_idx = t - (n_stages - 1)
-        store = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+        out_idx = t - last
+        emit = jnp.logical_and(stage == last, out_idx >= 0)
+        if l_fn is not None:
+            out_val = lax.cond(
+                emit, lambda _: l_fn(last_params, y),
+                lambda _: jnp.zeros(out_struct.shape, out_struct.dtype),
+                None)
+        else:
+            out_val = y
         upd = lax.dynamic_update_index_in_dim(
-            outputs, y.astype(outputs.dtype),
+            outputs, out_val.astype(outputs.dtype),
             jnp.clip(out_idx, 0, n_micro - 1), axis=0)
-        outputs = jnp.where(store, upd, outputs)
+        outputs = jnp.where(emit, upd, outputs)
         # hop every stage's activation one stage forward (single ppermute)
         in_flight = lax.ppermute(y, axis_name, fwd_perm)
         return (in_flight, outputs), None
 
-    (_, outputs), _ = lax.scan(tick, (act0, outputs0),
-                               jnp.arange(total_ticks))
+    (_, outputs), _ = lax.scan(tick, (act0, out0), jnp.arange(total_ticks))
     # results live on the last stage; replicate them
-    return broadcast_p(outputs, axis_name, root_rank=n_stages - 1)
+    return broadcast_p(outputs, axis_name, root_rank=last)
 
 
 def split_microbatches(x, n_micro: int):

@@ -91,3 +91,111 @@ def test_split_merge_roundtrip():
         np.asarray(x))
     with pytest.raises(ValueError, match="divisible"):
         split_microbatches(x, 5)
+
+
+V = 12  # vocab for the heterogeneous (embed -> blocks -> head) pipeline
+
+
+def _embed_fn(p, tok):           # [mb, T] int32 -> [mb, T, D]
+    return p["emb"][tok]
+
+
+def _head_fn(p, x):              # [mb, T, D] -> [mb, T, V]
+    return x @ p["out"]
+
+
+def _tblock_fn(p, x):            # [mb, T, D] -> [mb, T, D]
+    return x + jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _lm_params(seed=5):
+    rng = np.random.RandomState(seed)
+    return {
+        "emb": jnp.asarray(rng.randn(V, D).astype(np.float32) * 0.3),
+        "w": jnp.asarray(rng.randn(N_STAGES, D, D).astype(np.float32) * 0.4),
+        "b": jnp.asarray(rng.randn(N_STAGES, D).astype(np.float32) * 0.1),
+        "out": jnp.asarray(rng.randn(D, V).astype(np.float32) * 0.3),
+    }
+
+
+def _lm_sequential(params, tok):
+    x = _embed_fn({"emb": params["emb"]}, tok)
+    for s in range(N_STAGES):
+        x = _tblock_fn({"w": params["w"][s], "b": params["b"][s]}, x)
+    return _head_fn({"out": params["out"]}, x)
+
+
+def _lm_loss(logits, tgt):
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, tgt[..., None], axis=-1))
+
+
+def _lm_pipeline_fn(mesh, n_micro, remat=False):
+    def body(params, micro_tok):
+        local = {"w": params["w"][0], "b": params["b"][0]}
+        return pipeline_apply_p(
+            _tblock_fn, local, micro_tok, "pipe", N_STAGES,
+            first_fn=_embed_fn, first_params={"emb": params["emb"]},
+            last_fn=_head_fn, last_params={"out": params["out"]},
+            remat=remat)
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=({"emb": P(), "w": P("pipe"), "b": P("pipe"),
+                   "out": P()}, P()),
+        out_specs=P(), check_vma=False)
+
+
+@pytest.mark.parametrize("remat", [False, True])
+def test_pipeline_heterogeneous_lm_matches_dp(remat):
+    """VERDICT r3 item 5: a real LM pipeline — embedding (first stage only)
+    -> shape-uniform blocks -> head (last stage only) — must produce the
+    same loss AND gradients as the unpipelined (DP-style single-replica)
+    model, with and without per-stage remat."""
+    mesh = _mesh()
+    params = _lm_params()
+    rng = np.random.RandomState(6)
+    tok = jnp.asarray(rng.randint(0, V, size=(8, 5)).astype(np.int32))
+    tgt = jnp.asarray(rng.randint(0, V, size=(8, 5)).astype(np.int32))
+    n_micro = 4
+
+    def loss_dp(params):
+        return _lm_loss(_lm_sequential(params, tok), tgt)
+
+    fn = _lm_pipeline_fn(mesh, n_micro, remat=remat)
+    specs = {"emb": P(), "w": P("pipe"), "b": P("pipe"), "out": P()}
+    sharded = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+    micro_tok = split_microbatches(tok, n_micro)
+    micro_tgt = split_microbatches(tgt, n_micro)
+
+    def loss_pp(params):
+        logits = fn(params, micro_tok)
+        return _lm_loss(merge_microbatches(logits),
+                        merge_microbatches(micro_tgt))
+
+    l_ref, g_ref = jax.value_and_grad(loss_dp)(params)
+    l_pp, g_pp = jax.jit(jax.value_and_grad(loss_pp))(sharded)
+    np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-5)
+    for k in ("emb", "w", "b", "out"):
+        np.testing.assert_allclose(np.asarray(g_pp[k]), np.asarray(g_ref[k]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_bubbles_are_skipped():
+    """Bubble ticks must be genuine runtime conditionals (XLA skips the
+    stage compute), not masked always-computed work; and the schedule's
+    bubble fraction follows the fill-drain formula."""
+    from horovod_tpu.parallel.pipeline import pipeline_bubble_fraction
+    assert pipeline_bubble_fraction(4, 4) == pytest.approx(3 / 7)
+    assert pipeline_bubble_fraction(1, 8) == 0.0
+    mesh = _mesh()
+    fn = jax.jit(_pipeline_fn(mesh))
+    params = jax.device_put(_stacked_params(),
+                            NamedSharding(mesh, P("pipe")))
+    micro = split_microbatches(
+        jnp.zeros((16, D), jnp.float32), 4)
+    txt = fn.lower(params, micro).compile().as_text()
+    assert "conditional" in txt, \
+        "pipeline ticks compile without a runtime conditional (bubble " \
+        "ticks would do masked wasted work)"
