@@ -168,10 +168,7 @@ class Estimator:
                                                          root_rank=0))
 
         ragged = isinstance(train_data, ShardedNpzDataset)
-        if ragged:
-            x, y = train_data.shard_arrays(rank, size)
-            idx = np.arange(len(x))
-        else:
+        if not ragged:
             x, y = np.asarray(train_data[0]), np.asarray(train_data[1])
             idx = self._shard(len(x), rank, size)
 
@@ -180,30 +177,53 @@ class Estimator:
 
         for epoch in range(start_epoch, self.epochs):
             t0 = time.perf_counter()
-            order = idx
-            if self.shuffle:
-                order = np.random.RandomState(self.seed + epoch).permutation(idx)
-            losses = []
             if ragged:
-                # every batch trains, including the short tail; batch counts
-                # may differ across ranks — join() below squares that up
-                batch_starts = range(0, len(order), self.batch_size)
+                # streaming reader: bounded host RAM, background prefetch,
+                # per-epoch reshuffle (the Petastorm reader-loop role,
+                # spark/torch/remote.py:35-382). Every batch trains,
+                # including the short tail; batch counts may differ across
+                # ranks — join() below squares that up.
+                batches = train_data.iter_batches(
+                    rank, size, self.batch_size, shuffle=self.shuffle,
+                    seed=self.seed + epoch)
             else:
-                batch_starts = range(0, len(order) - self.batch_size + 1,
-                                     self.batch_size)
-            for lo in batch_starts:
-                sel = order[lo:lo + self.batch_size]
-                bx = jnp.asarray(x[sel])
-                by = jnp.asarray(y[sel])
-                loss, grads = grad_fn(params, bx, by)
+                order = idx
+                if self.shuffle:
+                    order = np.random.RandomState(
+                        self.seed + epoch).permutation(idx)
+                batches = ((x[order[lo:lo + self.batch_size]],
+                            y[order[lo:lo + self.batch_size]])
+                           for lo in range(0, len(order) - self.batch_size + 1,
+                                           self.batch_size))
+            losses = []
+            for bx, by in batches:
+                loss, grads = grad_fn(params, jnp.asarray(bx),
+                                      jnp.asarray(by))
                 params, opt_state = opt.update_and_apply(grads, opt_state,
                                                          params)
                 losses.append(loss)
             if ragged and size > 1:
                 # out of data for this epoch: match any still-training peers'
                 # reductions with zero substitutes (reference join semantics
-                # for the uneven last batches, operations.cc:1004-1040)
-                hvd.join()
+                # for the uneven last batches, operations.cc:1004-1040).
+                # join() returns the LAST rank to join — the one that saw the
+                # most batches and holds the most-updated replica. A joined
+                # rank substitutes zero grads but never applies the peers'
+                # later updates, so replicas diverge after an uneven epoch;
+                # re-sync everyone from the last joiner (the reference returns
+                # this rank for exactly this purpose). Equal batch counts
+                # mean nobody substituted and replicas are bit-identical —
+                # skip the (full-model) re-broadcast then.
+                from .common.reduce_ops import ReduceOp
+                last = hvd.join()
+                spread = np.asarray(hvd.allreduce(
+                    np.array([len(losses), -len(losses)], np.float64),
+                    name=f"est.nb.{epoch}", op=ReduceOp.MAX))
+                if spread[0] != -spread[1]:   # max(n) != min(n): diverged
+                    params = functions.broadcast_parameters(
+                        params, root_rank=last)
+                    opt_state = functions.broadcast_parameters(
+                        opt_state, root_rank=last)
             loss_sum = float(np.sum([float(np.asarray(l)) for l in losses])) \
                 if losses else 0.0
             n_batches = len(losses)
