@@ -60,12 +60,16 @@ class GlobalState:
             from .. import functions
             # Categorical dimensions, offered only where the topology can
             # express them (parameter_manager.h:225-228): the hierarchical
-            # ladders need >1 local rank; Pallas packing needs Pallas. The
-            # engine still collectively validates hierarchy at use time
-            # (_hierarchical_ok), so a heterogeneous topology degrades to
-            # flat — the GP then simply observes no score difference.
+            # ladders need >1 local rank; Pallas packing needs Pallas.
+            # The hierarchy offer must be COLLECTIVELY agreed (ADVICE r3):
+            # a rank-local local_size() test diverges on heterogeneous host
+            # assignments, and ranks would then build GP search spaces of
+            # different dimensionality — _sync_params would broadcast rank
+            # 0's vector into mis-shaped optimizers. _hierarchical_ok()
+            # allgathers local_size and requires uniformity, so every rank
+            # gets the same answer.
             categorical = []
-            if self.backend.local_size() > 1:
+            if self.backend.size() > 1 and self.engine._hierarchical_ok():
                 categorical += ["hierarchical_allreduce",
                                 "hierarchical_allgather"]
             if pallas_supported():

@@ -135,7 +135,12 @@ class TaskService:
             if key in self._seen_sigs:
                 return False
             if len(self._seen_sigs) >= self._seen_cap:
-                self._seen_sigs.pop(next(iter(self._seen_sigs)))
+                # Fail CLOSED (ADVICE r3): every cached signature is still
+                # inside its freshness window (expired ones were dropped
+                # above), so evicting one would silently re-open the replay
+                # hole for it. A burst past the cap — far above any
+                # legitimate launcher rate — is rejected instead.
+                return False
             # remember until the request's own window closes
             self._seen_sigs[key] = max(now, req_ts)
             return True
