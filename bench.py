@@ -28,6 +28,24 @@ import json
 import os
 import time
 
+# Persistent compilation cache: the bench now measures base + remat LM
+# configs, SP ring attention, and three ResNet paths (~15 XLA programs);
+# on a remote-compile rig each costs 30-90 s. The cache makes repeat runs
+# (and the driver's round-end run after this one) compile-free. Set via
+# jax.config (the env var is read at jax import, which sitecustomize does
+# before this file runs).
+try:
+    import jax as _jax_for_cache
+    _jax_for_cache.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR") or
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    _jax_for_cache.config.update("jax_persistent_cache_min_compile_time_secs",
+                                 1.0)
+except Exception:
+    pass
+
 BASELINE_IMG_S_PER_CHIP = 1656.82 / 16.0
 RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.1e9  # fwd ~4.1 GFLOPs, train ~3x
 
@@ -91,15 +109,12 @@ def _time_steps(fn, state, const_args, iters):
     return max(dt, 1e-9) / iters, rtt
 
 
-def bench_transformer():
-    """Flagship transformer-LM MFU (decoder LM, bf16, flash attention, lean
-    logsumexp loss). Timed as the marginal cost of extra scan steps inside
-    one jitted program (steps are dependent through the carried params, so
-    nothing can be elided or overlapped away), which excludes the tunnel's
-    per-dispatch overhead. MFU uses the analytic model-FLOPs convention
-    (6·N·tokens + causal attention counted at half the full T² matmul —
-    remat/recompute would not count, though this config uses none).
-    """
+def _measure_lm(cfg, B):
+    """Scan-marginal fwd+bwd+update timing of the flagship LM at batch B;
+    returns (step_time_s, n_params, model_flops). MFU uses the analytic
+    model-FLOPs convention (6·N·tokens + causal attention counted at half
+    the full T² matmul — remat recompute does NOT count extra flops, per
+    convention)."""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -107,14 +122,8 @@ def bench_transformer():
     from functools import partial
     from jax import lax
 
-    from horovod_tpu.models.transformer import (TransformerConfig,
-                                                init_params, lean_lm_loss)
+    from horovod_tpu.models.transformer import init_params, lean_lm_loss
 
-    cfg = TransformerConfig(
-        vocab_size=32768, d_model=2048, n_heads=16,
-        n_layers=int(os.environ.get("BENCH_LM_LAYERS", "4")),
-        d_ff=8192, max_seq=2048, dtype=jnp.bfloat16, attention="flash")
-    B = int(os.environ.get("BENCH_LM_BATCH", "4"))
     T = cfg.max_seq
     params = init_params(jax.random.PRNGKey(0), cfg)
     opt = optax.sgd(0.01, momentum=0.9)
@@ -147,15 +156,38 @@ def bench_transformer():
 
     import jax.tree_util as jtu
     n_params = sum(int(np.prod(v.shape)) for v in jtu.tree_leaves(params))
-    tokens = B * T
     # causal attention: half of the full 4·B·T²·D matmul flops, x3 for train
     attn_flops = cfg.n_layers * 4 * B * T * T * cfg.d_model * 3 // 2
-    model_flops = 6 * n_params * tokens + attn_flops
+    model_flops = 6 * n_params * (B * T) + attn_flops
+    return dt, n_params, model_flops
+
+
+def bench_transformer():
+    """Flagship transformer-LM MFU (decoder LM, bf16, flash attention, lean
+    logsumexp loss). Timed as the marginal cost of extra scan steps inside
+    one jitted program (steps are dependent through the carried params, so
+    nothing can be elided or overlapped away), which excludes the tunnel's
+    per-dispatch overhead. A second measurement at B>=8 with remat='block'
+    covers the large-batch config that OOMs without remat (VERDICT r3
+    item 4)."""
+    import dataclasses
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=32768, d_model=2048, n_heads=16,
+        n_layers=int(os.environ.get("BENCH_LM_LAYERS", "4")),
+        d_ff=8192, max_seq=2048, dtype=jnp.bfloat16, attention="flash")
+    B = int(os.environ.get("BENCH_LM_BATCH", "4"))
+    T = cfg.max_seq
+    dt, n_params, model_flops = _measure_lm(cfg, B)
     peak = _chip_peak_tflops(jax.devices()[0])
     tflops = model_flops / dt / 1e12
-    return {
+    out = {
         "transformer_step_time_ms": round(dt * 1e3, 3),
-        "transformer_tokens_per_sec": round(tokens / dt, 1),
+        "transformer_tokens_per_sec": round(B * T / dt, 1),
         "transformer_params_m": round(n_params / 1e6, 1),
         "transformer_model_tflops_per_step": round(model_flops / 1e12, 3),
         "transformer_achieved_tflops": round(tflops, 2),
@@ -164,6 +196,112 @@ def bench_transformer():
         "transformer_config": (f"d{cfg.d_model}xL{cfg.n_layers}x"
                                f"ff{cfg.d_ff} V{cfg.vocab_size} "
                                f"B{B} T{T} flash"),
+        # timing-convention label (VERDICT r3 weak #7): this number is the
+        # marginal cost of extra scan steps inside one jitted program —
+        # per-step dispatch/host cost is excluded by construction (the right
+        # convention on the tunneled rig, where dispatch is 10-80 ms)
+        "transformer_timing": "scan_marginal",
+    }
+    try:
+        rb = int(os.environ.get("BENCH_LM_REMAT_BATCH", "8"))
+        rcfg = dataclasses.replace(cfg, remat="block")
+        # splash's residual-saving fwd overflows scoped VMEM at B=8 under
+        # the remat recompute (block_kv 2048); the flash kernel fits —
+        # measured 58.8% MFU vs a compile error
+        prev = os.environ.get("HOROVOD_SPLASH")
+        os.environ["HOROVOD_SPLASH"] = "0"
+        try:
+            rdt, _, rflops = _measure_lm(rcfg, rb)
+        finally:
+            if prev is None:
+                os.environ.pop("HOROVOD_SPLASH", None)
+            else:
+                os.environ["HOROVOD_SPLASH"] = prev
+        rtf = rflops / rdt / 1e12
+        out.update({
+            "transformer_remat_step_time_ms": round(rdt * 1e3, 3),
+            "transformer_remat_mfu_pct": (round(100.0 * rtf / peak, 2)
+                                          if peak else None),
+            "transformer_remat_config": f"B{rb} T{T} remat=block flash",
+        })
+    except Exception as e:
+        out["transformer_remat_error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def bench_sp_ring():
+    """Sequence-parallel ring attention MFU at T=8192 (VERDICT r3 item 3):
+    fwd+bwd through the SP code path (shard_map + ring_attention_p with its
+    flash inner kernel and hand-written block VJP) on the available chips
+    (ring size = chip count; 1 on this rig — the multi-chip ring is
+    exercised on the 8-device CPU mesh by tests/test_ring_attention.py).
+    Scan-marginal timing; flops use the bench's analytic attention
+    convention (half the full T^2 matmul for causal, x3 for train)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.parallel.ring_attention import ring_attention_p
+
+    n = max(1, len(jax.devices()))
+    mesh = Mesh(np.array(jax.devices()), ("seq",))
+    B, T, H, D = 1, 8192, 16, 128
+
+    # check_vma=False: the Pallas kernels taken on the n==1 route don't
+    # carry VMA annotations for shard_map's checker
+    ring = jax.shard_map(
+        lambda q, k, v: ring_attention_p(q, k, v, "seq", n, causal=True),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3, out_specs=P(None, "seq"),
+        check_vma=False)
+
+    def attn_loss(q, k, v):
+        return jnp.sum(ring(q, k, v).astype(jnp.float32) ** 2)
+
+    def step(carry, _):
+        q, k, v = carry
+        dq, dk, dv = jax.grad(attn_loss, argnums=(0, 1, 2))(q, k, v)
+        # thread the grads back so scan steps are dependent (no elision)
+        return (q + 1e-6 * dq, k + 1e-6 * dk, v + 1e-6 * dv), ()
+
+    @partial(jax.jit, static_argnums=0)
+    def run(iters, st):
+        st, _ = lax.scan(step, st, None, length=iters)
+        # scalar completion token: fetching the full [B,T,H,D] array would
+        # cost seconds on the tunnel and swamp the marginal timing
+        return jnp.sum(st[0][0, 0, 0].astype(jnp.float32))
+
+    sh = NamedSharding(mesh, P(None, "seq"))
+    key = jax.random.PRNGKey(0)
+    st0 = tuple(
+        jax.device_put(jax.random.normal(k, (B, T, H, D), jnp.bfloat16) * 0.3,
+                       sh)
+        for k in jax.random.split(key, 3))
+    i1, i2 = 2, 6
+    for it in (i1, i2):
+        _fetch_scalar(run(it, st0))
+    t0 = time.perf_counter()
+    _fetch_scalar(run(i1, st0))
+    d1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    _fetch_scalar(run(i2, st0))
+    d2 = time.perf_counter() - t0
+    if d2 - d1 <= 0:
+        raise RuntimeError(
+            f"non-positive marginal ({d1 * 1e3:.1f} -> {d2 * 1e3:.1f} ms); "
+            f"noise swamped the measurement")
+    dt = (d2 - d1) / (i2 - i1)
+    model_flops = 4 * B * T * T * (H * D) * 3 // 2
+    peak = _chip_peak_tflops(jax.devices()[0])
+    tflops = model_flops / dt / 1e12 / n
+    return {
+        "sp_ring_step_time_ms": round(dt * 1e3, 3),
+        "sp_ring_attention_tflops_per_chip": round(tflops, 2),
+        "sp_ring_mfu_pct": (round(100.0 * tflops / peak, 2) if peak else None),
+        "sp_ring_config": f"B{B} T{T} H{H} D{D} causal ring{n}",
+        "sp_ring_timing": "scan_marginal",
     }
 
 
@@ -306,6 +444,11 @@ def main():
         lm = bench_transformer()
     except Exception as e:  # keep the headline metric robust
         lm = {"transformer_error": f"{type(e).__name__}: {e}"}
+    try:
+        sp = bench_sp_ring()
+    except Exception as e:
+        sp = {"sp_ring_error": f"{type(e).__name__}: {e}"}
+    lm.update(sp)
 
     print(json.dumps({
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
@@ -330,6 +473,11 @@ def main():
         # the 8-device virtual mesh (tests/test_compiled_structure.py), and
         # the eager number is the collective-path measurement.
         "overhead_control_exercises_collectives": n_chips > 1,
+        # dependent eager steps, single end-of-loop fetch, tunnel RTT
+        # subtracted — includes real per-step dispatch cost (unlike the
+        # transformer's scan_marginal convention; labels make BENCH_r*.json
+        # self-describing, VERDICT r3 weak #7)
+        "resnet_timing": "dependent_steps",
         **lm,
     }))
     hvd.shutdown()
