@@ -412,17 +412,23 @@ def main():
         updates, opt_state = eager_opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state
 
+    bench_step = [0]
+
     def eager_step(params, batch_stats, opt_state, images, labels):
         (loss, new_bs), grads = grad_fn(params, batch_stats, images, labels)
         leaves, treedef = jax.tree_util.tree_flatten(grads)
         # Route through the engine unconditionally (even at size 1) so the
         # measured loop includes registration, fusion bucketing, and the
-        # stacked collective launch.
-        handles = eng.grouped_allreduce(leaves, name="bench.grad",
+        # stacked collective launch. The update chains onto the handles'
+        # futures (Handle.result) with NO host block — the r4 eager hot
+        # path; per-step names let consecutive steps pipeline.
+        handles = eng.grouped_allreduce(leaves,
+                                        name=f"bench.grad.{bench_step[0]}",
                                         op=hvd.Average if hvd.size() > 1
                                         else hvd.Sum)
+        bench_step[0] += 1
         reduced = jax.tree_util.tree_unflatten(
-            treedef, [h.synchronize() for h in handles])
+            treedef, [h.result() for h in handles])
         params, opt_state = apply_fn(params, opt_state, reduced)
         return params, new_bs, opt_state, loss
 

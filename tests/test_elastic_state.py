@@ -121,6 +121,29 @@ class TestRunLoop:
         assert len(resets) == 1
         assert state.syncs == 2  # initial + after restore
 
+    def test_jax_runtime_error_restores_and_retries(self):
+        """The async eager hot path never blocks inside engine code, so a
+        peer crash first surfaces at the user's next value fetch as a raw
+        JAX runtime error — the run-loop must treat it like
+        HorovodInternalError (restore + reset + retry), or
+        dataflow-chained training loses elastic recovery."""
+        import jax
+        state = self._state()
+        resets = []
+        attempts = []
+
+        def train(s):
+            attempts.append(1)
+            if len(attempts) == 1:
+                raise jax.errors.JaxRuntimeError(
+                    "DATA_LOSS: Connection reset by peer")
+            return "ok"
+
+        wrapped = run_fn(train, lambda: resets.append(1))
+        assert wrapped(state) == "ok"
+        assert state.restores == 1
+        assert len(resets) == 1
+
     def test_hosts_updated_skips_sync_on_add(self):
         state = self._state()
         attempts = []
