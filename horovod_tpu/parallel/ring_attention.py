@@ -238,7 +238,24 @@ def ring_attention_p(q, k, v, axis_name: str, axis_size: int,
     def _merge(o, lse, t, k_cur, v_cur):
         kv_block = (my_block - t) % n
         kpos = (kv_block * T + jnp.arange(T)).astype(jnp.float32)
-        o_b, lse_b = _flash_block(causal, q, k_cur, v_cur, qpos, kpos)
+
+        def compute(_):
+            return _flash_block(causal, q, k_cur, v_cur, qpos, kpos)
+
+        if causal:
+            # blocks strictly after this rank's queries are FULLY masked —
+            # a real lax.cond skips their matmuls at runtime instead of
+            # computing scores that the mask zeroes (on average half the
+            # ring steps; the skipped branch's (0, _NEG_INF) is the merge
+            # identity, so numerics are untouched)
+            o_b, lse_b = lax.cond(
+                kv_block <= my_block, compute,
+                lambda _: (_vary(jnp.zeros((B, T, H, D), jnp.float32)),
+                           _vary(jnp.full((B, H, T), _NEG_INF,
+                                          jnp.float32))),
+                None)
+        else:
+            o_b, lse_b = compute(None)
         # logsumexp residual merge; the _NEG_INF sentinel keeps every
         # exponent finite (empty⊕empty rows stay ~_NEG_INF with o = 0)
         lse_new = jnp.logaddexp(lse, lse_b)
