@@ -172,7 +172,13 @@ class TPUState(ObjectState):
 
     def _save_pytrees(self):
         import jax
-        self._saved_pytrees = {k: jax.device_get(v)
+        from ..core.engine import _translate_failure
+        # commit() is the canonical per-batch sync point of an elastic
+        # loop; with the chained (no-host-block) optimizer a peer crash
+        # first surfaces HERE, at the device_get — translate it so the
+        # run-loop's restore/retry always sees HorovodInternalError
+        # regardless of the backend's raw error class.
+        self._saved_pytrees = {k: _translate_failure(jax.device_get, v)
                                for k, v in self._pytrees.items()}
 
     def save(self):

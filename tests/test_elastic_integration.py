@@ -34,29 +34,64 @@ TOTAL = int(os.environ["TEST_TOTAL_BATCHES"])
 OUT = os.environ["TEST_OUT_DIR"]
 
 hvd.init()
-state = hvd.elastic.ObjectState(batch=0)
-
 
 CRASH_RANK = int(os.environ.get("TEST_CRASH_RANK", "-1"))
 CRASH_BATCH = int(os.environ.get("TEST_CRASH_BATCH", "-1"))
 CRASH_MARKER = os.path.join(OUT, "crashed.marker")
+CHAINED = os.environ.get("TEST_CHAINED") == "1"
 
 
-@hvd.elastic.run
-def train(state):
-    while state.batch < TOTAL:
-        if (state.batch == CRASH_BATCH and hvd.rank() == CRASH_RANK
-                and not os.path.exists(CRASH_MARKER)):
-            with open(CRASH_MARKER, "w") as f:
-                f.write(str(os.getpid()))
-            os._exit(137)  # simulated hard crash (SIGKILL-style)
-        out = np.asarray(hvd.allreduce(np.ones(2), name=f"b{state.batch}",
-                                       op=hvd.Sum))
-        assert out[0] == hvd.size(), (out, hvd.size())
-        state.batch += 1
-        state.commit()
-        time.sleep(float(os.environ.get("TEST_BATCH_SLEEP", "0.1")))
-    return {"rank": hvd.rank(), "size": hvd.size(), "batch": state.batch}
+def _maybe_crash(batch):
+    if (batch == CRASH_BATCH and hvd.rank() == CRASH_RANK
+            and not os.path.exists(CRASH_MARKER)):
+        with open(CRASH_MARKER, "w") as f:
+            f.write(str(os.getpid()))
+        os._exit(137)  # simulated hard crash (SIGKILL-style)
+
+
+if CHAINED:
+    # The no-host-block optimizer path: a peer crash surfaces at
+    # state.commit()'s device_get (translated to HorovodInternalError),
+    # NOT inside any engine wait — the dataflow-chained elastic scenario.
+    import jax.numpy as jnp
+    import optax
+    from horovod_tpu.optimizer import DistributedEagerOptimizer
+
+    w0 = {"w": np.ones(4, np.float32)}
+    state = hvd.elastic.TPUState(params=w0,
+                                 opt_state=optax.sgd(0.05).init(w0),
+                                 batch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        opt = DistributedEagerOptimizer(optax.sgd(0.05))
+        grad_fn = jax.jit(jax.grad(lambda p, x: jnp.sum((p["w"] * x) ** 2)))
+        while state.batch < TOTAL:
+            _maybe_crash(state.batch)
+            p = jax.tree_util.tree_map(jnp.asarray, state.params)
+            o = jax.tree_util.tree_map(jnp.asarray, state.opt_state)
+            p, o = opt.update_and_apply(grad_fn(p, jnp.ones(4)), o, p)
+            state.params, state.opt_state = p, o
+            state.batch += 1
+            state.commit()
+            time.sleep(float(os.environ.get("TEST_BATCH_SLEEP", "0.1")))
+        return {"rank": hvd.rank(), "size": hvd.size(),
+                "batch": state.batch,
+                "w0": float(np.asarray(state.params["w"])[0])}
+else:
+    state = hvd.elastic.ObjectState(batch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.batch < TOTAL:
+            _maybe_crash(state.batch)
+            out = np.asarray(hvd.allreduce(np.ones(2), name=f"b{state.batch}",
+                                           op=hvd.Sum))
+            assert out[0] == hvd.size(), (out, hvd.size())
+            state.batch += 1
+            state.commit()
+            time.sleep(float(os.environ.get("TEST_BATCH_SLEEP", "0.1")))
+        return {"rank": hvd.rank(), "size": hvd.size(), "batch": state.batch}
 
 
 result = train(state)
@@ -210,3 +245,29 @@ def test_elastic_crash_recovery(tmp_path):
     # job completed despite the hard kill
     assert all(r["batch"] == 60 for r in results), results
     assert sorted(r["rank"] for r in results) == [0, 1, 2]
+
+
+@pytest.mark.integration
+def test_elastic_crash_recovery_chained_optimizer(tmp_path):
+    """Same hard-kill scenario, but the training loop is the r4
+    dataflow-chained DistributedEagerOptimizer (zero host blocks inside
+    engine code): survivors first see the dead peer at commit()'s
+    device_get, which TPUState translates to HorovodInternalError — the
+    elastic loop must still restore, re-rendezvous, and finish at full
+    size with consistent replicas."""
+    hostsfile, t, errors, _driver = _launch(
+        tmp_path, "localhost:3\n", np_=3, max_np=3, total_batches=40,
+        extra_env={"TEST_CRASH_RANK": "2", "TEST_CRASH_BATCH": "12",
+                   "TEST_CHAINED": "1"})
+    t.join(timeout=240)
+    assert not t.is_alive(), "elastic job did not finish"
+    assert not errors, errors
+    assert os.path.exists(str(tmp_path / "out" / "crashed.marker")), \
+        "the designated worker never crashed"
+    results = _done_results(tmp_path)
+    assert len(results) == 3, results
+    assert all(r["size"] == 3 for r in results), results
+    assert all(r["batch"] == 40 for r in results), results
+    # replicas agree after recovery (averaged grads + committed state)
+    w0s = {round(r["w0"], 6) for r in results}
+    assert len(w0s) == 1, results
