@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .ring_attention import local_attention
+from .flash_attention import flash_attention_local
 
 
 def ulysses_attention_p(q, k, v, axis_name: str, axis_size: int,
@@ -40,7 +40,7 @@ def ulysses_attention_p(q, k, v, axis_name: str, axis_size: int,
     """
     n = axis_size
     if n == 1:
-        return local_attention(q, k, v, causal=causal)
+        return flash_attention_local(q, k, v, causal=causal)
     heads = q.shape[2]
     if heads % n != 0:
         raise ValueError(
@@ -62,6 +62,9 @@ def ulysses_attention_p(q, k, v, axis_name: str, axis_size: int,
     kh = seq_to_heads(k)
     vh = seq_to_heads(v)
     # full-sequence attention on this device's head slice; the global causal
-    # mask is now an ordinary local causal mask
-    oh = local_attention(qh, kh, vh, causal=causal)
+    # mask is now an ordinary local causal mask — and the compute is a
+    # plain single-shard attention, so it takes the tuned Pallas
+    # flash/splash kernel on TPU (materialized fallback elsewhere / for
+    # 128-unaligned lengths)
+    oh = flash_attention_local(qh, kh, vh, causal=causal)
     return heads_to_seq(oh)
