@@ -133,3 +133,10 @@ def test_transformer_lm_example_eager():
               "--seq", "32", "--batch", "4", "--steps", "2"])
     assert r.returncode == 0, r.stderr[-2000:]
     assert "tokens_per_sec" in r.stdout, r.stdout
+
+
+def test_sparse_embedding_example():
+    r = _run([os.path.join(EXAMPLES, "sparse_embedding.py"),
+              "--steps", "10", "--vocab", "5000"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "sparse" in r.stdout and "saved" in r.stdout, r.stdout
