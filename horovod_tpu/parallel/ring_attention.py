@@ -26,6 +26,18 @@ permutation automatically.
 
 Use inside shard_map with the sequence axis manual; see
 ``horovod_tpu.models.transformer`` for the full integration.
+
+Kernel routing: ring size 1 dispatches to the tuned single-shard Pallas
+kernels (``parallel/flash_attention.py``); the n>1 inner kernel is the
+chunked pure-JAX flash above (measured ~3x slower than the Pallas kernels
+at T=8192 on v5e, but portable and exactly differentiable through the
+merge). The staged upgrade for multi-chip rings is a whole-ring
+``custom_vjp``: with the GLOBAL lse in hand, each block's backward is the
+*standard* flash backward under residuals ``(m=lse, l=1)`` — i.e. the
+stock Pallas dq/dkv kernels apply per block with no lse-cotangent term —
+while dk/dv rotate with the ring. That removes the need for the per-block
+dlse VJP entirely; it is staged because it re-schedules the backward by
+hand and this rig cannot measure an n>1 TPU ring.
 """
 
 from __future__ import annotations
