@@ -149,3 +149,22 @@ def test_flagship_spmd_step_contains_gradient_reduction():
     n_coll = (_count(r"all-reduce", hlo) + _count(r"reduce-scatter", hlo)
               + _count(r"all-gather", hlo) + _count(r"collective-permute", hlo))
     assert n_coll >= 3, f"expected gradient/activation collectives, got {n_coll}"
+
+
+def test_fused_broadcast_is_one_collective_per_bucket():
+    """grouped_broadcast's bucket program (r4): 40 packed leaves + the
+    root-active flag -> the broadcastable data travels as ONE collective
+    (the masked-psum broadcast of the packed buffer), with only the tiny
+    flag as a second one — never one collective per leaf."""
+    mesh = _world_mesh()
+    shapes = tuple((5, 4) for _ in range(40))
+    fn = C.build_fused_broadcast(mesh, "world", 0, shapes, jnp.float32)
+    total = sum(int(np.prod(s)) for s in shapes)
+    packed = jax.device_put(jnp.zeros((8, total), jnp.float32),
+                            NamedSharding(mesh, P("world")))
+    active = jax.device_put(jnp.ones((8, 1), jnp.int32),
+                            NamedSharding(mesh, P("world")))
+    hlo = _hlo(fn, packed, active)
+    n_ar = _count(r"all-reduce(?:-start)?\(", hlo)
+    assert n_ar <= 2, \
+        f"expected <=2 collectives (packed data + flag), found {n_ar}"
