@@ -74,8 +74,12 @@ def _build_splash_kernel(sk, sm, h: int, t: int, causal: bool):
     mask = sm.MultiHeadMask([mk((t, t)) for _ in range(h)])
     bq = min(1024, t)
     # kv block 2048 is the measured winner but must divide t (odd multiples
-    # of 1024, e.g. T=3072, take the 1024 block)
-    bkv = 2048 if t % 2048 == 0 else 1024
+    # of 1024, e.g. T=3072, take the 1024 block). Overridable: the
+    # residual-saving forward overflows scoped VMEM at large batch under
+    # remat recompute with 2048; 1024 fits (bench.py uses the flash
+    # fallback there by default).
+    bkv_pref = int(os.environ.get("HOROVOD_SPLASH_BLOCK_KV", "2048"))
+    bkv = bkv_pref if t % bkv_pref == 0 else 1024
     bd = min(1024, t)
     bs = sk.BlockSizes(block_q=bq, block_kv=bkv, block_kv_compute=bkv,
                        block_q_dkv=bd, block_kv_dkv=bd,
