@@ -242,6 +242,22 @@ def test_steady_state_sized_ops_no_host_roundtrips():
         assert r["b"] == 7.0, r
 
 
+@pytest.mark.integration
+def test_sized_ops_with_meta_cache_disabled():
+    """HOROVOD_TPU_META_CACHE=0 restores the always-negotiate behavior:
+    one blocking size exchange per sized op (20 over the measured rounds),
+    zero deferred checks, same results."""
+    from horovod_tpu.runner import run
+    env = _mp_env()
+    env["HOROVOD_TPU_META_CACHE"] = "0"
+    results = run(_worker_steady_state_sized_ops, np=2, env=env)
+    for r in results:
+        assert r["fetches"] == 20, r     # 10 allgather + 10 alltoall
+        assert r["checks"] == 0, r
+        assert r["g_rows"] == 3 and r["recv_rows"] == 3, r
+        assert r["b"] == 7.0, r
+
+
 def _worker_meta_cache_mismatch():
     """When a rank's sizes change after the per-name cache went hot, every
     rank must RAISE (never hang, never return garbage): hot peers via the
