@@ -18,8 +18,6 @@ shape (many heads + moderate T → Ulysses; few heads or extreme T → ring).
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
 from jax import lax
 
 from .flash_attention import flash_attention_local
