@@ -52,8 +52,10 @@ from jax import lax
 _NEG_INF = -1e30
 # K/V chunk length of the flash inner kernel. 512 keeps the per-chunk score
 # slab [B,H,Tq,512] comfortably inside VMEM-friendly tiling at the T_locals
-# that matter while giving the MXU full-width contractions.
-_KV_CHUNK = 512
+# that matter while giving the MXU full-width contractions. Tunable per
+# chip generation via HOROVOD_RING_CHUNK.
+import os as _os
+_KV_CHUNK = int(_os.environ.get("HOROVOD_RING_CHUNK", "512"))
 
 
 def _vary_like(x, ref):
