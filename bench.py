@@ -146,13 +146,19 @@ def _measure_lm(cfg, B):
     for it in (i1, i2):
         _, loss = run(it, st0)
         _fetch_scalar(loss)
-    t0 = time.perf_counter()
-    _fetch_scalar(run(i1, st0)[1])
-    d1 = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    _fetch_scalar(run(i2, st0)[1])
-    d2 = time.perf_counter() - t0
-    dt = max((d2 - d1) / (i2 - i1), 1e-9)
+    # best-of-2 marginal: the chip is pooled on this rig and a co-tenant
+    # burst during one pair poisons the difference; the MIN marginal is the
+    # machine's capability (compiles are cached, so a repeat pair is cheap)
+    dt = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        _fetch_scalar(run(i1, st0)[1])
+        d1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _fetch_scalar(run(i2, st0)[1])
+        d2 = time.perf_counter() - t0
+        m = max((d2 - d1) / (i2 - i1), 1e-9)
+        dt = m if dt is None else min(dt, m)
 
     import jax.tree_util as jtu
     n_params = sum(int(np.prod(v.shape)) for v in jtu.tree_leaves(params))
@@ -200,7 +206,7 @@ def bench_transformer():
         # marginal cost of extra scan steps inside one jitted program —
         # per-step dispatch/host cost is excluded by construction (the right
         # convention on the tunneled rig, where dispatch is 10-80 ms)
-        "transformer_timing": "scan_marginal",
+        "transformer_timing": "scan_marginal_best_of_2",
     }
     try:
         rb = int(os.environ.get("BENCH_LM_REMAT_BATCH", "8"))
@@ -284,17 +290,22 @@ def bench_sp_ring():
     i1, i2 = 2, 6
     for it in (i1, i2):
         _fetch_scalar(run(it, st0))
-    t0 = time.perf_counter()
-    _fetch_scalar(run(i1, st0))
-    d1 = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    _fetch_scalar(run(i2, st0))
-    d2 = time.perf_counter() - t0
-    if d2 - d1 <= 0:
+    # best-of-2 marginal (pooled-chip noise resistance, see _measure_lm)
+    dt = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        _fetch_scalar(run(i1, st0))
+        d1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _fetch_scalar(run(i2, st0))
+        d2 = time.perf_counter() - t0
+        if d2 - d1 > 0:
+            m = (d2 - d1) / (i2 - i1)
+            dt = m if dt is None else min(dt, m)
+    if dt is None:
         raise RuntimeError(
-            f"non-positive marginal ({d1 * 1e3:.1f} -> {d2 * 1e3:.1f} ms); "
-            f"noise swamped the measurement")
-    dt = (d2 - d1) / (i2 - i1)
+            "non-positive marginals in both attempts; noise swamped the "
+            "measurement")
     model_flops = 4 * B * T * T * (H * D) * 3 // 2
     peak = _chip_peak_tflops(jax.devices()[0])
     tflops = model_flops / dt / 1e12 / n
@@ -303,7 +314,7 @@ def bench_sp_ring():
         "sp_ring_attention_tflops_per_chip": round(tflops, 2),
         "sp_ring_mfu_pct": (round(100.0 * tflops / peak, 2) if peak else None),
         "sp_ring_config": f"B{B} T{T} H{H} D{D} causal ring{n}",
-        "sp_ring_timing": "scan_marginal",
+        "sp_ring_timing": "scan_marginal_best_of_2",
     }
 
 
