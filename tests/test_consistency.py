@@ -93,7 +93,26 @@ def _worker_matching_ok():
         np.zeros((hvd.rank() + 1, 2), np.float32), name="ag"))
     outs = hvd.grouped_allreduce(
         [np.ones(2), np.ones((2, 2))], name="grp", op=hvd.Average)
-    return (float(out[0]), g.shape[0], len(outs))
+    # fused broadcast (r4): matching submissions pass the checker too
+    bp = hvd.broadcast_parameters(
+        {"a": np.full((2,), float(hvd.rank())),
+         "b": np.full((3, 2), float(hvd.rank()))}, root_rank=1)
+    return (float(out[0]), g.shape[0], len(outs),
+            float(np.asarray(bp["a"])[0]))
+
+
+def _worker_grouped_broadcast_mismatch():
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+    from horovod_tpu.common.exceptions import TensorShapeMismatchError
+    shape = (2, 2) if hvd.rank() == 0 else (3, 2)
+    try:
+        hvd.broadcast_parameters({"w": np.ones(shape)}, root_rank=0)
+    except TensorShapeMismatchError as e:
+        return ("raised", "Mismatched shape" in str(e))
+    return ("no-error", None)
 
 
 @pytest.mark.integration
@@ -102,6 +121,7 @@ def _worker_matching_ok():
     (_worker_dtype_mismatch, "dtype"),
     (_worker_op_mismatch, "op"),
     (_worker_name_mismatch, "name"),
+    (_worker_grouped_broadcast_mismatch, "grouped-broadcast-shape"),
 ])
 def test_mismatch_raises_on_every_rank(worker, desc):
     from horovod_tpu.runner import run
@@ -113,4 +133,4 @@ def test_mismatch_raises_on_every_rank(worker, desc):
 def test_matching_submissions_pass():
     from horovod_tpu.runner import run
     results = run(_worker_matching_ok, np=2, env=_mp_env())
-    assert results == [(2.0, 3, 2), (2.0, 3, 2)], results
+    assert results == [(2.0, 3, 2, 1.0), (2.0, 3, 2, 1.0)], results
