@@ -101,6 +101,25 @@ def _worker_matching_ok():
             float(np.asarray(bp["a"])[0]))
 
 
+def _worker_equal_sizes_violation():
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+    from horovod_tpu.common.exceptions import TensorShapeMismatchError
+    eng = hvd._engine()
+    d0 = 2 if hvd.rank() == 0 else 3
+    try:
+        # equal_sizes=True is a caller contract (dim 0 matches everywhere);
+        # debug mode validates dim 0 for it (unlike plain allgather, where
+        # uneven dim 0 is legitimate)
+        eng.allgather(np.ones((d0, 2), np.float32), name="eq",
+                      equal_sizes=True).synchronize()
+    except TensorShapeMismatchError as e:
+        return ("raised", "Mismatched shape" in str(e))
+    return ("no-error", None)
+
+
 def _worker_grouped_broadcast_mismatch():
     import numpy as np
     import jax
@@ -122,6 +141,7 @@ def _worker_grouped_broadcast_mismatch():
     (_worker_op_mismatch, "op"),
     (_worker_name_mismatch, "name"),
     (_worker_grouped_broadcast_mismatch, "grouped-broadcast-shape"),
+    (_worker_equal_sizes_violation, "equal-sizes-contract"),
 ])
 def test_mismatch_raises_on_every_rank(worker, desc):
     from horovod_tpu.runner import run
