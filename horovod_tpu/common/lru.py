@@ -1,0 +1,40 @@
+"""LRU helpers over plain (insertion-ordered) dicts.
+
+One shared implementation for every bounded cache in the package (compiled
+XLA builders, the steady-state size cache, the optimizer's jit caches) —
+the role of the reference's LRU response cache bookkeeping
+(common/response_cache.h:45-102). Plain-dict + pop/reinsert keeps each
+operation a single atomic-under-the-GIL dict call, so caches shared
+between the user thread and the engine's cycle thread degrade to a
+miss/no-op under concurrent invalidation, never a KeyError.
+"""
+
+from __future__ import annotations
+
+_MISSING = object()
+
+
+def lru_get(cache: dict, key, default=None):
+    """Fetch + MRU-touch; ``default`` on miss."""
+    val = cache.pop(key, _MISSING)
+    if val is _MISSING:
+        return default
+    cache[key] = val
+    return val
+
+
+def lru_put(cache: dict, key, val, cap: int):
+    """Insert as MRU, evicting the LRU entry when growing past ``cap``.
+    Overwriting an existing key never evicts an unrelated entry."""
+    if key not in cache and len(cache) >= max(cap, 1):
+        cache.pop(next(iter(cache)), None)
+    cache.pop(key, None)
+    cache[key] = val
+    return val
+
+
+def lru_touch(cache: dict, key, val):
+    """Re-insert ``key`` as MRU (no capacity check). Tolerates the entry
+    having been concurrently removed."""
+    cache.pop(key, None)
+    cache[key] = val

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 
 from ..common import env as env_mod
 from ..common.exceptions import DuplicateNameError, HorovodInternalError
+from ..common.lru import lru_get, lru_put, lru_touch
 from ..common.reduce_ops import ReduceOp
 from ..ops import collectives as C
 from ..parallel.mesh import WORLD_AXIS
@@ -143,7 +144,11 @@ class Handle:
         return self._done
 
     def synchronize(self):
-        if not self._done:
+        # poll() first: if the arrays are already ready (the cycle thread
+        # just hasn't retired the handle yet) this is not a blocking wait
+        # and must not count as one (ADVICE r4 — host_blocks is the
+        # "actual blocking waits" counter the chained-eager tests assert on)
+        if not self._done and not self.poll():
             self._engine.host_blocks += 1
             if self._group is not None:
                 self._group.wait()
@@ -332,19 +337,15 @@ class Engine:
         return WORLD_AXIS
 
     def _builder(self, key: tuple, make: Callable):
-        fn = self._builders.get(key)
+        # The builder cache is the ResponseCache analog
+        # (response_cache.h:45-102); HOROVOD_CACHE_CAPACITY bounds it with
+        # LRU eviction, so a working set one entry over capacity doesn't
+        # re-trace its hottest builder every cycle (ADVICE r2).
+        fn = lru_get(self._builders, key)
         self._last_builder_fresh = fn is None
         if fn is None:
-            # The builder cache is the ResponseCache analog
-            # (response_cache.h:45-102); HOROVOD_CACHE_CAPACITY bounds it
-            # with LRU eviction, so a working set one entry over capacity
-            # doesn't re-trace its hottest builder every cycle (ADVICE r2).
-            if len(self._builders) >= max(self.config.cache_capacity, 1):
-                self._builders.pop(next(iter(self._builders)))
-            fn = make()
-        else:
-            del self._builders[key]  # re-insert -> most-recently-used
-        self._builders[key] = fn
+            fn = lru_put(self._builders, key, make(),
+                         self.config.cache_capacity)
         return fn
 
     def _auto_name(self, kind: str) -> str:
@@ -1151,8 +1152,7 @@ class Engine:
         ent = self._meta_cache.get(key)
         if (self.config.meta_cache and ent is not None
                 and ent["streak"] >= self.config.meta_cache_warmup):
-            del self._meta_cache[key]          # re-insert -> MRU
-            self._meta_cache[key] = ent
+            lru_touch(self._meta_cache, key, ent)
             garr = self._dispatch_exchange(local_vec)
             # If THIS rank's sizes changed while peers are hot, taking the
             # blocking path here would make this rank build a differently-
@@ -1171,15 +1171,18 @@ class Engine:
         if ent is not None and np.array_equal(ent["world"], world):
             ent["streak"] += 1
             ent["local"] = local_vec.copy()
+            # MRU-touch on the warming path too (ADVICE r4): under cache
+            # pressure an entry one call short of hot must not be the LRU
+            # victim or it never reaches steady state. lru_touch tolerates
+            # the cycle thread having concurrently invalidated the entry
+            # while this thread blocked in _exchange_sizes — and
+            # re-inserting is sound even then, because the fresh exchange
+            # just confirmed ent["world"] is the live world observation.
+            lru_touch(self._meta_cache, key, ent)
         else:
-            # evict only when actually growing — overwriting an existing
-            # key must not drop an unrelated hot entry
-            if key not in self._meta_cache and \
-                    len(self._meta_cache) >= max(self.config.cache_capacity,
-                                                 1):
-                self._meta_cache.pop(next(iter(self._meta_cache)))
-            self._meta_cache[key] = {"world": world, "streak": 1,
-                                     "local": local_vec.copy()}
+            lru_put(self._meta_cache, key,
+                    {"world": world, "streak": 1, "local": local_vec.copy()},
+                    self.config.cache_capacity)
         return world, None
 
     def _verify_deferred(self, name: str, deferred) -> None:

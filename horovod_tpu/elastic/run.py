@@ -19,12 +19,24 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
 
 from ..common.exceptions import (HorovodInternalError, HostsUpdatedInterrupt,
                                  WorkerRemovedError)
 from .worker import notification_manager
 
 _LOG = logging.getLogger("horovod_tpu.elastic")
+
+# Raw JAX runtime errors are ambiguous: a peer crash surfaces as one on the
+# dataflow-chained path, but so do deterministic failures (device OOM,
+# asserts in user jit code). The reference only ever recovers
+# HorovodInternalError (common/elastic.py:147-168), so unbounded retry on
+# raw runtime errors would loop forever on a persistent non-collective bug
+# (ADVICE r4 medium). We recover them a bounded number of CONSECUTIVE times
+# — the counter resets whenever training proves progress via state.commit()
+# — then escalate to the user.
+_MAX_RUNTIME_ERROR_RETRIES = int(os.environ.get(
+    "HOROVOD_ELASTIC_MAX_RUNTIME_RETRIES", "3"))
 
 
 def _recoverable_errors():
@@ -80,13 +92,30 @@ def run_fn(func, reset):
         notification_manager().init()
         notification_manager().register_listener(state)
         skip_sync = False
+        raw_failures = 0  # consecutive raw-runtime-error recoveries
         try:
             while True:
                 if not skip_sync:
                     state.sync()
+                commits_before = getattr(state, "_commit_count", 0)
                 try:
                     return func(state, *args, **kwargs)
-                except _recoverable_errors():
+                except _recoverable_errors() as e:
+                    if isinstance(e, HorovodInternalError):
+                        raw_failures = 0  # definitely a collective failure
+                    else:
+                        if getattr(state, "_commit_count", 0) > commits_before:
+                            raw_failures = 0  # progress since last failure
+                        raw_failures += 1
+                        if raw_failures > _MAX_RUNTIME_ERROR_RETRIES:
+                            _LOG.error(
+                                "%d consecutive runtime errors with no "
+                                "intervening state.commit(); this looks like "
+                                "a deterministic failure, not a peer crash — "
+                                "escalating (HOROVOD_ELASTIC_MAX_RUNTIME_"
+                                "RETRIES=%d)", raw_failures,
+                                _MAX_RUNTIME_ERROR_RETRIES)
+                            raise
                     _LOG.info("collective failure; restoring last committed "
                               "state and re-initializing")
                     state.restore()

@@ -62,6 +62,12 @@ class State:
 
     def commit(self):
         self.save()
+        # monotone progress marker, bumped only AFTER save() succeeds: the
+        # elastic run-loop uses it to tell "training advanced since the
+        # last failure" from "failing on the very same step every retry"
+        # (bounded-retry escalation, ADVICE r4) — a commit whose save
+        # raises must not count as progress
+        self._commit_count = getattr(self, "_commit_count", 0) + 1
         self.check_host_updates()
 
     def check_host_updates(self):

@@ -20,6 +20,7 @@ import hashlib
 import hmac
 import http.server
 import json
+import logging
 import os
 import secrets as _secrets
 import signal
@@ -29,6 +30,8 @@ import urllib.request
 from typing import Dict, List, Optional
 
 from . import safe_shell_exec
+
+_LOG = logging.getLogger("horovod_tpu.runner")
 
 SIG_HEADER = "X-HVD-Signature"
 TS_HEADER = "X-HVD-Timestamp"
@@ -114,6 +117,7 @@ class TaskService:
         # well above any legitimate request rate for a 300 s window)
         self._seen_sigs: Dict[str, float] = {}
         self._seen_cap = 4096
+        self._cap_logged = False
 
     def note_signature(self, ts: str, sig: str) -> bool:
         """Record a (timestamp, signature) pair; False if already seen
@@ -139,8 +143,23 @@ class TaskService:
                 # inside its freshness window (expired ones were dropped
                 # above), so evicting one would silently re-open the replay
                 # hole for it. A burst past the cap — far above any
-                # legitimate launcher rate — is rejected instead.
+                # legitimate launcher rate (4096 entries over a ~330 s
+                # window is >12 req/s sustained) — is rejected instead,
+                # and LOUDLY (once per episode, so the burst that caused
+                # the lockout can't also flood the log at its own rate):
+                # operators must be able to tell capacity lockout from
+                # replay rejection (ADVICE r4).
+                if not self._cap_logged:
+                    self._cap_logged = True
+                    _LOG.error(
+                        "task-service replay cache full (%d unexpired "
+                        "signatures); rejecting NEW requests for capacity, "
+                        "not replay. A crash-looping launcher or clock "
+                        "skew can cause this; service recovers as entries "
+                        "age out of the %ds freshness window.",
+                        len(self._seen_sigs), MAX_CLOCK_SKEW_S)
                 return False
+            self._cap_logged = False  # room again: next episode logs anew
             # remember until the request's own window closes
             self._seen_sigs[key] = max(now, req_ts)
             return True

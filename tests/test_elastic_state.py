@@ -144,6 +144,53 @@ class TestRunLoop:
         assert state.restores == 1
         assert len(resets) == 1
 
+    def test_persistent_runtime_error_escalates(self):
+        """Raw JAX runtime errors are only AMBIGUOUS evidence of a peer
+        crash; a deterministic failure (OOM, assert in user jit code) that
+        recurs with no intervening commit must escalate after the bounded
+        retry budget instead of restore/retry-looping forever (ADVICE r4
+        medium — the reference only ever recovers HorovodInternalError)."""
+        import jax
+        import importlib
+        run_mod = importlib.import_module('horovod_tpu.elastic.run')
+        state = self._state()
+        attempts = []
+
+        def train(s):
+            attempts.append(1)
+            raise jax.errors.JaxRuntimeError("INTERNAL: deterministic bug")
+
+        budget = run_mod._MAX_RUNTIME_ERROR_RETRIES
+        wrapped = run_fn(train, lambda: None)
+        with pytest.raises(jax.errors.JaxRuntimeError):
+            wrapped(state)
+        # initial attempt + the module's retry budget of recoveries
+        assert len(attempts) == budget + 1
+        assert state.restores == budget
+
+    def test_runtime_error_retry_budget_resets_on_commit(self):
+        """A commit between failures proves training advanced — the
+        consecutive-failure counter starts over, so transient peer crashes
+        spread across a long run never hit the escalation cap."""
+        import jax
+        import importlib
+        run_mod = importlib.import_module('horovod_tpu.elastic.run')
+        state = self._state()
+        attempts = []
+        n_fail = run_mod._MAX_RUNTIME_ERROR_RETRIES * 2  # well past budget
+
+        def train(s):
+            attempts.append(1)
+            if len(attempts) <= n_fail:
+                state.commit()  # progress before every failure
+                raise jax.errors.JaxRuntimeError(
+                    "DATA_LOSS: Connection reset by peer")
+            return "ok"
+
+        wrapped = run_fn(train, lambda: None)
+        assert wrapped(state) == "ok"  # every failure recovered
+        assert state.restores == n_fail
+
     def test_hosts_updated_skips_sync_on_add(self):
         state = self._state()
         attempts = []
