@@ -27,7 +27,15 @@ def lru_put(cache: dict, key, val, cap: int):
     """Insert as MRU, evicting the LRU entry when growing past ``cap``.
     Overwriting an existing key never evicts an unrelated entry."""
     if key not in cache and len(cache) >= max(cap, 1):
-        cache.pop(next(iter(cache)), None)
+        # len+iter+pop is NOT one atomic dict op: a concurrent invalidation
+        # (the engine cycle thread pops meta-cache entries) can land
+        # between iter() and next() (RuntimeError) or empty the dict first
+        # (StopIteration). Degrade to skipping the eviction — one entry
+        # over cap beats crashing the training step.
+        try:
+            cache.pop(next(iter(cache)), None)
+        except (StopIteration, RuntimeError):
+            pass
     cache.pop(key, None)
     cache[key] = val
     return val

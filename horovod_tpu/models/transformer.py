@@ -54,6 +54,16 @@ class TransformerConfig:
     # materialized attention off-TPU and under sequence parallelism, where
     # ring/ulysses own the kernel).
     attention: str = "ring"
+    # Sequence-parallel data layout: "contiguous" (rank r holds block r) or
+    # "zigzag" (rank r holds stripes (r, 2n-1-r) — causally load-balanced:
+    # every rank does identical per-ring-step work; see
+    # parallel/ring_attention.py zigzag_indices, which the data loader must
+    # apply to tokens/targets). Ring attention only: Ulysses re-gathers the
+    # full sequence in axis order, so a zigzag-permuted sequence would
+    # break its causal mask. The lean LM has no positional encoding, so
+    # the layout is otherwise transparent to the model; the per-token loss
+    # mean is permutation-invariant.
+    sp_layout: str = "contiguous"
     # MoE FFN (expert parallelism): experts sharded over the tensor axis
     use_moe: bool = False
     n_experts: int = 8
@@ -172,9 +182,18 @@ def _forward(params, tokens, cfg: TransformerConfig,
         k = jnp.einsum(qkv_eq, x, wk.astype(dt))
         v = jnp.einsum(qkv_eq, x, wv.astype(dt))
         if seq_size is not None and seq_size > 1:
-            attn_p = (ulysses_attention_p if cfg.attention == "ulysses"
-                      else ring_attention_p)
-            att = attn_p(q, k, v, SEQ_AXIS, seq_size, causal=causal)
+            if cfg.attention == "ulysses":
+                if cfg.sp_layout == "zigzag" and causal:
+                    raise ValueError(
+                        "sp_layout='zigzag' needs ring attention: Ulysses "
+                        "re-gathers the sequence in axis order, which under "
+                        "a zigzag permutation breaks the causal mask")
+                att = ulysses_attention_p(q, k, v, SEQ_AXIS, seq_size,
+                                          causal=causal)
+            else:
+                att = ring_attention_p(q, k, v, SEQ_AXIS, seq_size,
+                                       causal=causal,
+                                       layout=cfg.sp_layout)
         elif flash:
             att = flash_attention_local(q, k, v, causal=causal,
                                         layout="bhtk")

@@ -49,6 +49,32 @@ def test_spmd_loss_matches_single_device(shape):
     assert abs(out - ref) / abs(ref) < 1e-4, (out, ref)
 
 
+def test_spmd_loss_zigzag_layout_matches():
+    """sp_layout='zigzag' (causally load-balanced ring): feeding the
+    zigzag-permuted tokens/targets must give the SAME loss — the per-token
+    loss mean is permutation-invariant, and the lean LM has no positional
+    encoding, so only the ring schedule changes."""
+    from horovod_tpu.parallel.ring_attention import zigzag_indices
+    d, s, t = 1, 4, 1
+    devs = np.array(jax.devices()[:d * s * t]).reshape(d, s, t)
+    mesh = Mesh(devs, (tfm.DATA_AXIS, tfm.SEQ_AXIS, tfm.TENSOR_AXIS))
+    cfg = dataclasses.replace(CFG, sp_layout="zigzag")
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    inputs, targets = _data(bsz=4, seq=16)
+    ref = float(_single_device_loss(params, inputs, targets))
+
+    idx, _ = zigzag_indices(16, s)
+    loss_fn = tfm.make_spmd_loss(mesh, cfg)
+    sharded_params = tfm.shard_params(params, mesh, cfg)
+    tok_sh = NamedSharding(mesh, P(tfm.DATA_AXIS, tfm.SEQ_AXIS))
+    zi = jnp.take(jnp.asarray(inputs), idx, axis=1)
+    zt = jnp.take(jnp.asarray(targets), idx, axis=1)
+    out = float(jax.jit(loss_fn)(sharded_params,
+                                 jax.device_put(zi, tok_sh),
+                                 jax.device_put(zt, tok_sh)))
+    assert abs(out - ref) / abs(ref) < 1e-4, (out, ref)
+
+
 def test_spmd_train_step_decreases_loss_and_matches_dp1():
     mesh = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
                 (tfm.DATA_AXIS, tfm.SEQ_AXIS, tfm.TENSOR_AXIS))
