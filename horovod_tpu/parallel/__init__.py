@@ -10,15 +10,19 @@ point-to-point primitive set was the transport for).
 """
 
 from .mesh import WORLD_AXIS, world_mesh
-from .ring_attention import local_attention, ring_attention_p
+from .ring_attention import (local_attention, ring_attention_p,
+                             zigzag_indices)
 from .ulysses import ulysses_attention_p
 from .moe import MoEParams, init_moe, moe_layer_p
 from .pipeline import (merge_microbatches, pipeline_apply_p,
+                       pipeline_train_1f1b,
                        split_microbatches)
 
 __all__ = [
     "WORLD_AXIS", "world_mesh",
-    "local_attention", "ring_attention_p", "ulysses_attention_p",
+    "local_attention", "ring_attention_p", "zigzag_indices",
+    "ulysses_attention_p",
     "MoEParams", "init_moe", "moe_layer_p",
-    "pipeline_apply_p", "split_microbatches", "merge_microbatches",
+    "pipeline_apply_p", "pipeline_train_1f1b", "split_microbatches",
+    "merge_microbatches",
 ]

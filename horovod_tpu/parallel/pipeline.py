@@ -21,14 +21,18 @@ Training-grade properties (VERDICT r3 item 5):
   with a shape-uniform ring (only the [mb, T, D] activation ever hops).
 - **Activation-memory control.** ``remat=True`` wraps each stage application
   in ``jax.checkpoint``: the backward recomputes the stage from its input,
-  so per-tick residuals shrink from every intermediate to one activation —
-  the fill-drain analog of 1F1B's bounded live-activation window (the
-  schedule itself remains fill-drain; a true interleaved 1F1B would need a
-  hand-scheduled backward and buys only the same memory bound).
+  so per-tick residuals shrink from every intermediate to one activation.
+  NOTE the bound this buys is still O(n_micro): AD through ``lax.scan``
+  stores (at least) the scan carry per tick, so the backward's live set
+  grows with the microbatch count. For n_micro ≫ n_stages use
+  :func:`pipeline_train_1f1b` below — a hand-scheduled 1F1B whose stash is
+  a static ``2·n_stages−1`` slots, giving O(n_stages) live activations
+  independent of n_micro (VERDICT r4 item 4).
 
-Differentiable end-to-end: AD transposes the ppermute (reverse hop), the
-conds, and the scan, so pipeline-parallel training needs no hand-written
-backward schedule.
+``pipeline_apply_p`` stays differentiable end-to-end: AD transposes the
+ppermute (reverse hop), the conds, and the scan — the simple choice when
+n_micro is moderate. ``pipeline_train_1f1b`` is the training-grade
+schedule when it isn't.
 """
 
 from __future__ import annotations
@@ -152,6 +156,205 @@ def pipeline_apply_p(stage_fn: Callable, stage_params, micro_inputs,
     (_, outputs), _ = lax.scan(tick, (act0, out0), jnp.arange(total_ticks))
     # results live on the last stage; replicate them
     return broadcast_p(outputs, axis_name, root_rank=last)
+
+
+def _vary(x, axis_name):
+    """Mark constants varying over the pipe axis (shard_map VMA typing);
+    no-op outside manual regions / on older jax."""
+    try:
+        return lax.pcast(x, (axis_name,), to="varying")
+    except Exception:
+        return x
+
+
+def pipeline_train_1f1b(stage_fn: Callable, stage_params, micro_inputs,
+                        micro_targets, loss_fn: Callable,
+                        axis_name: str, n_stages: int,
+                        first_fn: Optional[Callable] = None,
+                        first_params=None,
+                        last_fn: Optional[Callable] = None,
+                        last_params=None):
+    """Memory-bounded 1F1B pipeline training step (run inside shard_map).
+
+    The schedule: stage s runs the FORWARD of microbatch m at tick
+    ``m + s`` and its BACKWARD at tick ``m + 2·(n_stages−1) − s`` — the
+    last stage's backward follows its forward immediately (the defining
+    1F1B property), cotangents flow back one hop per tick, and every stage
+    is doing one F and one B in steady state. Total ticks:
+    ``n_micro + 2·(n_stages−1)``; bubble fraction identical to fill-drain.
+
+    Memory is the point (VERDICT r4 item 4): each backward *recomputes* its
+    stage from the stashed stage INPUT inside ``jax.vjp`` (remat by
+    construction), so a stage keeps at most ``2·n_stages−1`` stashed
+    activations — O(n_stages), independent of n_micro — where
+    differentiating the fill-drain scan with AD keeps O(n_micro) live.
+
+    Args:
+      stage_fn: ``(stage_params, x) -> y`` shape-preserving stage.
+      stage_params: THIS stage's parameter pytree (sharded over the axis).
+      micro_inputs: ``[n_micro, mb, ...]`` raw microbatch inputs
+        (replicated). Stage 0 reads them (through ``first_fn`` if given).
+      micro_targets: ``[n_micro, mb, ...]`` per-microbatch targets
+        (replicated); only the last stage reads them.
+      loss_fn: ``(out, target) -> scalar`` per-microbatch loss (a mean —
+        the returned loss is the mean over microbatches).
+      first_fn/first_params: optional stage-0 embedding
+        ``(first_params, micro) -> activation``.
+      last_fn/last_params: optional last-stage head
+        ``(last_params, y) -> out``.
+
+    Returns ``(loss, stage_grads, first_grads, last_grads)``: loss is the
+    replicated scalar mean; stage_grads is per-stage (varying over the
+    axis, like stage_params); first/last grads are replicated (psum'd, so
+    every rank can run the same optimizer update on the replicated
+    first/last params).
+    """
+    if n_stages < 2:
+        raise ValueError("pipeline_train_1f1b needs n_stages >= 2; a "
+                         "single stage is just a plain train step")
+    n_micro = micro_inputs.shape[0]
+    if n_micro < 1:
+        raise ValueError("need at least one microbatch")
+    stage = lax.axis_index(axis_name)
+    last = n_stages - 1
+    total_ticks = n_micro + 2 * last
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+    depth = 2 * n_stages - 1  # stash lifetime bound: 2*(last-s)+1 ticks
+
+    has_first = first_fn is not None
+    has_last = last_fn is not None
+    if first_params is None:
+        first_params = ()
+    if last_params is None:
+        last_params = ()
+
+    # activation struct probing (the ring is shape-uniform)
+    if has_first:
+        act_struct = jax.eval_shape(first_fn, first_params, micro_inputs[0])
+    else:
+        act_struct = jax.eval_shape(lambda x: x, micro_inputs[0])
+    act0 = _vary(jnp.zeros(act_struct.shape, act_struct.dtype), axis_name)
+
+    def stage0_composite(sp, fp, micro):
+        x = first_fn(fp, micro) if has_first else micro.astype(act0.dtype)
+        return stage_fn(sp, x)
+
+    def last_composite(sp, lp, x, tgt):
+        y = stage_fn(sp, x)
+        out = last_fn(lp, y) if has_last else y
+        return loss_fn(out, tgt)
+
+    def zeros_like_tree(t):
+        return jax.tree_util.tree_map(
+            lambda a: _vary(jnp.zeros(a.shape, a.dtype), axis_name), t)
+
+    def _zero_loss():
+        return _vary(jnp.zeros((), jnp.float32), axis_name)
+
+    def tick(carry, t):
+        fwd_in, bwd_in, stash, gs, gf, gl, loss_acc = carry
+        m_f = t - stage
+        m_b = t - 2 * last + stage
+        # the last stage's F work happens inside its B-slot recompute, so
+        # its F slot (and stash) are skipped entirely
+        f_active = jnp.logical_and(jnp.logical_and(m_f >= 0,
+                                                   m_f < n_micro),
+                                   stage != last)
+        b_active = jnp.logical_and(m_b >= 0, m_b < n_micro)
+        micro_f = lax.dynamic_index_in_dim(
+            micro_inputs, jnp.clip(m_f, 0, n_micro - 1), 0, keepdims=False)
+        micro_b = lax.dynamic_index_in_dim(
+            micro_inputs, jnp.clip(m_b, 0, n_micro - 1), 0, keepdims=False)
+        tgt_b = lax.dynamic_index_in_dim(
+            micro_targets, jnp.clip(m_b, 0, n_micro - 1), 0, keepdims=False)
+
+        # ---- F slot: compute this stage's activation, stash its input
+        def do_f(_):
+            x = lax.cond(stage == 0,
+                         lambda _: (first_fn(first_params, micro_f)
+                                    if has_first
+                                    else micro_f.astype(act0.dtype)),
+                         lambda _: fwd_in, None)
+            return stage_fn(stage_params, x), x
+
+        y_f, x_f = lax.cond(f_active, do_f,
+                            lambda _: (act0, act0), None)
+        stash = lax.cond(
+            f_active,
+            lambda st: lax.dynamic_update_index_in_dim(
+                st, x_f, jnp.mod(m_f, depth), 0),
+            lambda st: st, stash)
+
+        # ---- B slot: recompute the stage from its stashed input inside
+        # jax.vjp (remat by construction), pull the cotangent through
+        x_b = lax.dynamic_index_in_dim(stash, jnp.mod(m_b, depth), 0,
+                                       keepdims=False)
+
+        def b_first(_):
+            _, pull = jax.vjp(
+                lambda sp, fp: stage0_composite(sp, fp, micro_b),
+                stage_params, first_params)
+            dgs, dgf = pull(bwd_in)
+            return (dgs, dgf, zeros_like_tree(last_params), act0,
+                    _zero_loss())
+
+        def b_mid(_):
+            _, pull = jax.vjp(stage_fn, stage_params, x_b)
+            dgs, dx = pull(bwd_in)
+            return (dgs, zeros_like_tree(first_params),
+                    zeros_like_tree(last_params), dx, _zero_loss())
+
+        def b_last(_):
+            # x arrives THIS tick via fwd_in (sent by stage last-1 at the
+            # previous tick); loss seeds the cotangent chain
+            loss_m, pull = jax.vjp(
+                lambda sp, lp, x: last_composite(sp, lp, x, tgt_b),
+                stage_params, last_params, fwd_in)
+            dgs, dgl, dx = pull(jnp.ones_like(loss_m))
+            return (dgs, zeros_like_tree(first_params), dgl, dx,
+                    loss_m.astype(jnp.float32))
+
+        def do_b(_):
+            role = jnp.where(stage == 0, 0,
+                             jnp.where(stage == last, 2, 1)).astype(jnp.int32)
+            return lax.switch(role, (b_first, b_mid, b_last), None)
+
+        def skip_b(_):
+            return (zeros_like_tree(stage_params),
+                    zeros_like_tree(first_params),
+                    zeros_like_tree(last_params), act0, _zero_loss())
+
+        dgs, dgf, dgl, dx_b, loss_c = lax.cond(b_active, do_b, skip_b, None)
+
+        gs = jax.tree_util.tree_map(jnp.add, gs, dgs)
+        gf = jax.tree_util.tree_map(jnp.add, gf, dgf)
+        gl = jax.tree_util.tree_map(jnp.add, gl, dgl)
+        loss_acc = loss_acc + loss_c
+
+        # communication: activations hop forward, cotangents hop backward
+        fwd_in = lax.ppermute(y_f, axis_name, fwd_perm)
+        bwd_in = lax.ppermute(dx_b, axis_name, bwd_perm)
+        return (fwd_in, bwd_in, stash, gs, gf, gl, loss_acc), None
+
+    stash0 = _vary(jnp.zeros((depth,) + tuple(act_struct.shape),
+                             act_struct.dtype), axis_name)
+    carry0 = (act0, act0, stash0,
+              zeros_like_tree(stage_params), zeros_like_tree(first_params),
+              zeros_like_tree(last_params), _zero_loss())
+    (fwd_in, bwd_in, stash, gs, gf, gl,
+     loss_acc), _ = lax.scan(tick, carry0, jnp.arange(total_ticks))
+
+    inv = 1.0 / n_micro
+    # loss lives on the last stage, first/last grads on their stages: psum
+    # replicates them (all other ranks contribute zeros)
+    loss = lax.psum(loss_acc, axis_name) * inv
+    gf = jax.tree_util.tree_map(
+        lambda a: lax.psum(a * inv, axis_name), gf)
+    gl = jax.tree_util.tree_map(
+        lambda a: lax.psum(a * inv, axis_name), gl)
+    gs = jax.tree_util.tree_map(lambda a: a * inv, gs)
+    return loss, gs, gf, gl
 
 
 def split_microbatches(x, n_micro: int):
