@@ -261,6 +261,7 @@ def join() -> int:
 # Convenience re-exports
 from . import optimizer  # noqa: E402
 DistributedOptimizer = optimizer.DistributedOptimizer
+DistributedDeltaAdasumOptimizer = optimizer.DistributedDeltaAdasumOptimizer
 from .ops.compression import Compression  # noqa: E402
 from . import functions as _functions  # noqa: E402
 broadcast_parameters = _functions.broadcast_parameters
@@ -280,7 +281,8 @@ __all__ = [
     "broadcast_parameters", "broadcast_object", "allgather_object",
     "allreduce_sparse",
     "broadcast_optimizer_state",
-    "DistributedOptimizer", "Compression", "optimizer", "elastic",
+    "DistributedOptimizer", "DistributedDeltaAdasumOptimizer",
+    "Compression", "optimizer", "elastic",
     "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max", "Product",
     "HorovodInternalError", "HostsUpdatedInterrupt", "DuplicateNameError",
     "__version__",

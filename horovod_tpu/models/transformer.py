@@ -182,6 +182,7 @@ def _forward(params, tokens, cfg: TransformerConfig,
         k = jnp.einsum(qkv_eq, x, wk.astype(dt))
         v = jnp.einsum(qkv_eq, x, wv.astype(dt))
         if seq_size is not None and seq_size > 1:
+            remat_hint = cfg.remat != "none"
             if cfg.attention == "ulysses":
                 if cfg.sp_layout == "zigzag" and causal:
                     raise ValueError(
@@ -189,14 +190,17 @@ def _forward(params, tokens, cfg: TransformerConfig,
                         "re-gathers the sequence in axis order, which under "
                         "a zigzag permutation breaks the causal mask")
                 att = ulysses_attention_p(q, k, v, SEQ_AXIS, seq_size,
-                                          causal=causal)
+                                          causal=causal,
+                                          under_remat=remat_hint)
             else:
                 att = ring_attention_p(q, k, v, SEQ_AXIS, seq_size,
                                        causal=causal,
-                                       layout=cfg.sp_layout)
+                                       layout=cfg.sp_layout,
+                                       under_remat=remat_hint)
         elif flash:
             att = flash_attention_local(q, k, v, causal=causal,
-                                        layout="bhtk")
+                                        layout="bhtk",
+                                        under_remat=cfg.remat != "none")
         else:
             att = local_attention(q, k, v, causal=causal)
         out = jnp.einsum("bhtk,hkd->btd" if flash else "bthk,hkd->btd",

@@ -52,6 +52,20 @@ def _is_varying(x, axis_name: str) -> bool:
         return True  # outside a manual region / older jax: assume local values
 
 
+def _vma_tracking_active(axis_name: str) -> bool:
+    """Whether the surrounding shard_map actually tracks varying axes
+    (check_vma=True). Under check_vma=False EVERY value reports an empty
+    vma set, so a pre-summed-gradient guard keyed on _is_varying would
+    misfire on perfectly good per-shard gradients; probe by pcasting a
+    fresh constant and seeing if the annotation sticks."""
+    try:
+        import jax.numpy as _jnp
+        probe = jax.lax.pcast(_jnp.zeros(()), (axis_name,), to="varying")
+        return axis_name in jax.typeof(probe).vma
+    except Exception:
+        return False
+
+
 def allreduce_gradients(grads, axis_name: str, op: ReduceOp = Average,
                         compression=Compression.none, axis_size: Optional[int] = None):
     """Reduce a gradient pytree across ``axis_name`` inside traced code.
@@ -68,7 +82,12 @@ def allreduce_gradients(grads, axis_name: str, op: ReduceOp = Average,
     def reduce_leaf(g):
         varying = _is_varying(g, axis_name)
         if op == Adasum:
-            if not varying:
+            # Adasum callers compute local grads by construction; the
+            # pre-summed guard is only decidable when the surrounding
+            # shard_map tracks varying axes (check_vma=True) — under
+            # check_vma=False every value reports unvarying and the guard
+            # would misfire, so proceed with the collective there.
+            if not varying and _vma_tracking_active(axis_name):
                 raise ValueError(
                     "op=Adasum needs per-shard gradients; it cannot recover "
                     "local contributions from an implicitly pre-summed "
@@ -410,3 +429,164 @@ def DistributedOptimizer(inner: optax.GradientTransformation, op: ReduceOp = Ave
     """Reference-named factory (torch/optimizer.py:367 DistributedOptimizer)."""
     return DistributedEagerOptimizer(inner, op=op, compression=compression,
                                      backward_passes_per_step=backward_passes_per_step)
+
+
+# ---------------------------------------------------------------------------
+# Delta-model Adasum (the reference's SECOND Adasum integration)
+# ---------------------------------------------------------------------------
+#
+# The reference ships Adasum in two forms: gradient reduction with op=Adasum
+# (covered by allreduce_gradients/DistributedEagerOptimizer above), and
+# _DistributedAdasumOptimizer (torch/optimizer.py:196-364, tensorflow/
+# __init__.py:303-397): apply the LOCAL optimizer step first and
+# Adasum-reduce the parameter DELTA — the form that preserves Adasum's
+# scale-invariance under adaptive optimizers (Adam's preconditioner runs on
+# the local gradient before mixing, so the mixing weights see the actual
+# step geometry). The torch code realizes delta = -α·f(g) by zeroing a
+# stashed copy and diffing after an in-place step; under optax the delta
+# IS the functional ``updates`` tree, so the TPU form reduces the inner
+# transformation's updates — no stash, no diff.
+
+
+def distributed_delta_adasum(inner: optax.GradientTransformation,
+                             axis_name: str = "world",
+                             axis_size: Optional[int] = None,
+                             compression=Compression.none
+                             ) -> optax.GradientTransformation:
+    """SPMD delta-Adasum: wrap ``inner`` so its *updates* (the parameter
+    delta) are Adasum-combined across ``axis_name`` inside a pjit/shard_map
+    train step. Usage mirrors :func:`distributed`."""
+    if axis_size is None:
+        raise ValueError("distributed_delta_adasum needs axis_size")
+
+    def init_fn(params):
+        return inner.init(params)
+
+    def update_fn(grads, state, params=None):
+        # probe once per update, not per leaf (it emits a pcast each call)
+        tracking = _vma_tracking_active(axis_name)
+
+        def check(g):
+            if tracking and not _is_varying(g, axis_name):
+                raise ValueError(
+                    "delta-Adasum needs per-shard gradients; an implicitly "
+                    "pre-summed (unvarying) gradient has already mixed the "
+                    "replicas. Make the params varying (lax.pcast to "
+                    "'varying') before jax.grad, or compute grads of a "
+                    "local loss.")
+            return g
+        grads = jax.tree_util.tree_map(check, grads)
+        updates, new_state = inner.update(grads, state, params)
+
+        def reduce_leaf(u):
+            c, ctx = compression.compress(u)
+            return compression.decompress(
+                adasum_p(c, axis_name, axis_size), ctx)
+
+        return jax.tree_util.tree_map(reduce_leaf, updates), new_state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class DistributedDeltaAdasumOptimizer:
+    """Eager (process-parallel) delta-model Adasum optimizer
+    (torch/optimizer.py:196-364 _DistributedAdasumOptimizer).
+
+    Each step: the inner optax update runs on the LOCAL gradients (one
+    jitted dispatch), the resulting update leaves — the parameter delta —
+    are Adasum-reduced through the engine, and a jitted apply chains
+    ``params + reduced_delta`` onto the reduction's dataflow futures
+    (no host block, like DistributedEagerOptimizer). The inner state
+    (e.g. Adam moments) advances from local gradients, exactly as the
+    reference's wrapped optimizer state does.
+    """
+
+    def __init__(self, inner: optax.GradientTransformation,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1):
+        self.inner = inner
+        self.compression = compression
+        self.backward_passes_per_step = backward_passes_per_step
+        self._accum = None
+        self._count = 0
+        self._step = 0
+        self._update_cache = {}
+        self._apply_cache = {}
+        self._cache_cap = 16
+
+    def init(self, params):
+        return self.inner.init(params)
+
+    def _engine(self):
+        from .core.state import global_state
+        st = global_state()
+        if not st.initialized:
+            raise ValueError("horovod_tpu has not been initialized; run "
+                             "hvd.init() first.")
+        return st.engine
+
+    def _update_fn(self, treedef):
+        fn = lru_get(self._update_cache, treedef)
+        if fn is None:
+            inner = self.inner
+
+            @jax.jit
+            def fn(grads, opt_state, params):
+                updates, new_state = inner.update(grads, opt_state, params)
+                return jax.tree_util.tree_leaves(updates), new_state
+
+            fn = lru_put(self._update_cache, treedef, fn, self._cache_cap)
+        return fn
+
+    def _apply_fn(self, treedef, ctxs):
+        key = (treedef, tuple(repr(c) for c in ctxs))
+        fn = lru_get(self._apply_cache, key)
+        if fn is None:
+            comp = self.compression
+
+            @jax.jit
+            def fn(reduced_c, params):
+                deltas = [comp.decompress(r, c)
+                          for r, c in zip(reduced_c, ctxs)]
+                updates = jax.tree_util.tree_unflatten(treedef, deltas)
+                return optax.apply_updates(params, updates)
+
+            fn = lru_put(self._apply_cache, key, fn, self._cache_cap)
+        return fn
+
+    def update_and_apply(self, grads, opt_state, params):
+        """Local inner step -> Adasum-reduce the delta -> apply. Returns
+        (new_params, new_opt_state); on intermediate accumulation passes
+        params are returned unchanged."""
+        if self.backward_passes_per_step > 1:
+            if self._accum is None:
+                self._accum = grads
+            else:
+                self._accum = jax.tree_util.tree_map(
+                    lambda a, g: a + g, self._accum, grads)
+            self._count += 1
+            if self._count < self.backward_passes_per_step:
+                return params, opt_state
+            grads = self._accum
+            self._accum = None
+            self._count = 0
+        eng = self._engine()
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        del leaves
+        u_leaves, new_state = self._update_fn(treedef)(grads, opt_state,
+                                                       params)
+        if eng.backend.size() == 1:
+            reduced, ctxs = u_leaves, [None] * len(u_leaves)
+        else:
+            from .ops.adasum import adasum_allreduce_handle
+            compressed, ctxs = [], []
+            for u in u_leaves:
+                c, ctx = self.compression.compress(u)
+                compressed.append(c)
+                ctxs.append(ctx)
+            handles = [adasum_allreduce_handle(
+                eng, c, f"delta.adasum.s{self._step}.{i}")
+                for i, c in enumerate(compressed)]
+            reduced = [h.result() for h in handles]
+            self._step = (self._step + 1) % 1024
+        return self._apply_fn(treedef, ctxs)(reduced, params), new_state

@@ -46,3 +46,134 @@ def test_adasum_requires_power_of_2(mesh8):
     from horovod_tpu.ops.adasum import adasum_p
     with pytest.raises(ValueError):
         adasum_p(jnp.zeros((4,)), WORLD_AXIS, 6)
+
+
+# ---------------------------------------------------------------------------
+# Delta-model Adasum (reference torch/optimizer.py:196-364): local optimizer
+# step first, Adasum-reduce the parameter DELTA. Same test strategy as above
+# — compare the distributed result against the NumPy VHDD formula applied to
+# host-computed per-rank deltas.
+# ---------------------------------------------------------------------------
+
+
+def _per_rank_updates(grads, params, n, steps_state=None):
+    """Host-side reference: each rank's Adam update on its local grads."""
+    import optax
+    inner = optax.adam(1e-2)
+    outs = []
+    for r in range(n):
+        st = inner.init(params)
+        u, _ = inner.update(jax.tree_util.tree_map(lambda g: g[r], grads),
+                            st, params)
+        outs.append(u)
+    return outs
+
+
+def test_delta_adasum_matches_numpy_reference(mesh8):
+    """delta-Adasum == params + VHDD(per-rank Adam updates), with the
+    per-rank updates computed from LOCAL grads (the property that makes
+    the delta form scale-invariant under adaptive optimizers)."""
+    import optax
+    from jax import shard_map
+    from horovod_tpu.optimizer import distributed_delta_adasum
+
+    n = 8
+    rng = np.random.RandomState(7)
+    params = {"w": jnp.asarray(rng.randn(4, 3).astype(np.float32)),
+              "b": jnp.asarray(rng.randn(3).astype(np.float32))}
+    grads = {"w": rng.randn(n, 4, 3).astype(np.float32),
+             "b": rng.randn(n, 3).astype(np.float32)}
+
+    opt = distributed_delta_adasum(optax.adam(1e-2), WORLD_AXIS, n)
+    state = opt.init(params)
+
+    def body(g, params):
+        g = jax.tree_util.tree_map(lambda a: a[0], g)  # drop the block dim
+        u, _ = opt.update(g, state, params)
+        return optax.apply_updates(params, u)
+
+    fn = jax.jit(shard_map(
+        body, mesh=mesh8, in_specs=(P(WORLD_AXIS), P()), out_specs=P(),
+        check_vma=False))
+    out = fn({"w": stacked(mesh8, grads["w"]),
+              "b": stacked(mesh8, grads["b"])}, params)
+
+    ref_updates = _per_rank_updates(grads, params, n)
+    for k in ("w", "b"):
+        expect = np.asarray(params[k]) + adasum_reference(
+            [np.asarray(u[k]) for u in ref_updates]).reshape(params[k].shape)
+        np.testing.assert_allclose(np.asarray(out[k]), expect,
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_delta_adasum_differs_from_grad_adasum_under_adam(mesh8):
+    """The reason the delta form exists: under Adam the local preconditioner
+    runs BEFORE the Adasum mixing, so delta-Adasum and grad-Adasum give
+    different parameters (they coincide only for plain SGD, where the
+    update is a linear function of the gradient)."""
+    import optax
+    from jax import shard_map
+    from horovod_tpu.optimizer import (allreduce_gradients,
+                                       distributed_delta_adasum)
+    from horovod_tpu.common.reduce_ops import Adasum
+
+    n = 8
+    rng = np.random.RandomState(9)
+    params = {"w": jnp.asarray(rng.randn(6).astype(np.float32))}
+    grads = {"w": (rng.randn(n, 6) * rng.uniform(0.1, 10, size=(n, 1)))
+             .astype(np.float32)}
+
+    delta_opt = distributed_delta_adasum(optax.adam(1e-2), WORLD_AXIS, n)
+    dstate = delta_opt.init(params)
+
+    def body_delta(g, params):
+        g = jax.tree_util.tree_map(lambda a: a[0], g)
+        u, _ = delta_opt.update(g, dstate, params)
+        return optax.apply_updates(params, u)
+
+    inner = optax.adam(1e-2)
+    gstate = inner.init(params)
+
+    def body_grad(g, params):
+        g = jax.tree_util.tree_map(lambda a: a[0], g)
+        rg = allreduce_gradients(g, WORLD_AXIS, op=Adasum, axis_size=n)
+        u, _ = inner.update(rg, gstate, params)
+        return optax.apply_updates(params, u)
+
+    sharded = {"w": stacked(mesh8, grads["w"])}
+    out_d = jax.jit(shard_map(body_delta, mesh=mesh8,
+                              in_specs=(P(WORLD_AXIS), P()), out_specs=P(),
+                              check_vma=False))(sharded, params)
+    out_g = jax.jit(shard_map(body_grad, mesh=mesh8,
+                              in_specs=(P(WORLD_AXIS), P()), out_specs=P(),
+                              check_vma=False))(sharded, params)
+    # both moved the params...
+    assert not np.allclose(np.asarray(out_d["w"]), np.asarray(params["w"]))
+    assert not np.allclose(np.asarray(out_g["w"]), np.asarray(params["w"]))
+    # ...to different points
+    assert not np.allclose(np.asarray(out_d["w"]), np.asarray(out_g["w"]),
+                           rtol=1e-3)
+
+
+def test_delta_adasum_eager_size1_is_local_step():
+    """Eager plumbing at world size 1: Adasum of one rank is the identity,
+    so update_and_apply must equal the plain inner step (and chain with no
+    host block)."""
+    import optax
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rng = np.random.RandomState(11)
+    params = {"w": jnp.asarray(rng.randn(5, 2).astype(np.float32))}
+    grads = {"w": jnp.asarray(rng.randn(5, 2).astype(np.float32))}
+
+    inner = optax.adam(1e-2)
+    ref_state = inner.init(params)
+    u, _ = inner.update(grads, ref_state, params)
+    expect = optax.apply_updates(params, u)
+
+    opt = hvd.DistributedDeltaAdasumOptimizer(optax.adam(1e-2))
+    st = opt.init(params)
+    out, _ = opt.update_and_apply(grads, st, params)
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               np.asarray(expect["w"]), rtol=1e-6)

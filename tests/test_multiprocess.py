@@ -383,6 +383,53 @@ def test_chained_eager_optimizer_no_host_blocks():
     assert r0["w"] == r1["w"]
 
 
+def _worker_delta_adasum():
+    """Delta-model Adasum (torch/optimizer.py:196-364): each rank applies
+    its LOCAL Adam step, the parameter deltas are Adasum-combined through
+    the engine, and the result must equal the NumPy VHDD formula applied
+    to the per-rank updates — and stay in lockstep across ranks."""
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+    import horovod_tpu as hvd
+
+    rank = hvd.rank()
+    rng = np.random.RandomState(3)
+    params = {"w": jnp.asarray(rng.randn(4).astype(np.float32))}
+    all_grads = rng.randn(2, 4).astype(np.float32)  # same on both ranks
+
+    inner = optax.adam(1e-2)
+    opt = hvd.DistributedDeltaAdasumOptimizer(optax.adam(1e-2))
+    st = opt.init(params)
+    g = {"w": jnp.asarray(all_grads[rank])}
+    out, _ = opt.update_and_apply(g, st, params)
+    jax.block_until_ready(out)
+
+    # host-side expectation: VHDD over both ranks' local Adam updates
+    from horovod_tpu.ops.adasum import adasum_reference
+    ups = []
+    for r in range(2):
+        u, _ = inner.update({"w": jnp.asarray(all_grads[r])},
+                            inner.init(params), params)
+        ups.append(np.asarray(u["w"]))
+    expect = np.asarray(params["w"]) + adasum_reference(ups)
+    return {"rank": rank, "w": np.asarray(out["w"]).tolist(),
+            "expect": expect.tolist()}
+
+
+@pytest.mark.integration
+def test_delta_adasum_two_process():
+    import numpy as _np
+    from horovod_tpu.runner import run
+    r0, r1 = run(_worker_delta_adasum, np=2, env=_mp_env())
+    assert r0["w"] == r1["w"]  # lockstep
+    _np.testing.assert_allclose(_np.asarray(r0["w"]),
+                                _np.asarray(r0["expect"]), rtol=1e-4,
+                                atol=1e-5)
+
+
 def _worker_throughput():
     """VERDICT r3 item 1b: eager-vs-SPMD throughput where dispatch is cheap
     (CPU backend, ~100us per dispatch) — separates framework cost from the
