@@ -91,13 +91,18 @@ def _time_steps(fn, state, const_args, iters):
     (*new_state, loss)``: each iteration feeds the previous output state back
     in (so the device cannot overlap or elide them), with a single scalar
     fetch at the end as the completion barrier."""
-    # Two state-threading warmups: the first compiles for the initial
-    # (host/uncommitted) state shardings, the second for the steady-state
-    # (device-committed) shardings the timed loop actually runs with.
+    # Four state-threading warmups: sharding transitions (host/uncommitted
+    # -> device-committed -> outputs-of-the-committed-program) trigger
+    # fresh jit variants through call THREE on the eager path — measured
+    # on-chip (jax_log_compiles): calls 0-2 each compile (12.3/4.5/5.6 s),
+    # call 3 is the first compile-free step. Two warmups put a multi-
+    # second compile inside the timed region (the r4 eager number's
+    # hidden tax).
     out = fn(*state, *const_args)
     _fetch_scalar(out[-1])
-    out = fn(*out[:-1], *const_args)
-    _fetch_scalar(out[-1])
+    for _ in range(3):
+        out = fn(*out[:-1], *const_args)
+        _fetch_scalar(out[-1])
     rtt = _measure_rtt(out[-1])
     state = out[:-1]
     t0 = time.perf_counter()
@@ -506,7 +511,7 @@ def main():
 
     eager_dt, _ = _time_steps(eager_step,
                               (params, batch_stats, eager_opt_state),
-                              (images, labels), max(iters // 4, 2))
+                              (images, labels), max(iters // 2, 4))
 
     # ---- report -----------------------------------------------------------
     spmd_img_s = batch / spmd_dt

