@@ -292,7 +292,7 @@ def bench_sp_ring():
       backward) — the r4 "staged Pallas ring backward", measured honestly.
 
     Timing: scan-marginal, i2 sized so the span is ~400+ ms of device time,
-    median of 3 marginals with the spread reported (VERDICT r4 weak #2:
+    median of 5 marginals with the spread reported (VERDICT r4 weak #2:
     the old 4-step span was the same order as the tunnel's per-fetch noise
     — THAT was the 21%-vs-56% 'bimodality' — and best-of-N is retired)."""
     import numpy as np
@@ -338,8 +338,10 @@ def bench_sp_ring():
             # seconds on the tunnel and swamp the timing
             return jnp.sum(st[0][0, 0, 0].astype(jnp.float32))
 
-        # ~10 ms/step x 40-step span >= ~400 ms >> tunnel noise
-        return _marginal_median(run, st0, 4, 44)
+        # ~10 ms/step x 40-step span >= ~400 ms >> tunnel noise; 5 reps
+        # (vs 3 elsewhere): these sections' spreads are what the driver
+        # checks for reproducibility, and a rep here costs only ~1 s
+        return _marginal_median(run, st0, 4, 44, reps=5)
 
     out = {}
     dt, spread, n_used = measure(

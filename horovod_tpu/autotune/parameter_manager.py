@@ -22,6 +22,8 @@ packing) that a static default cannot make per cluster:
 - hierarchical_allreduce / hierarchical_allgather (offered when
   local_size > 1)
 - pallas_pack (offered when Pallas is available)
+- single_launch (one-vs-two-dispatch grouped allreduce; the best choice
+  depends on dispatch overhead vs pack-fusion quality per runtime)
 
 Scoring: the interval between successive ``step_mark`` calls spans one
 full training step (mark fires at grouped-allreduce entry each step), so

@@ -750,6 +750,9 @@ class Engine:
             if pm.tunes("hierarchical_allgather"):
                 self.config.hierarchical_allgather = \
                     pm.categorical_value("hierarchical_allgather")
+            if pm.tunes("single_launch"):
+                self.config.single_launch = \
+                    pm.categorical_value("single_launch")
         names = [self._register(None if name is None else f"{name}.{i}",
                                 "grouped_allreduce", t.nbytes)
                  for i, t in enumerate(tensors)]

@@ -74,6 +74,8 @@ class GlobalState:
                                 "hierarchical_allgather"]
             if pallas_supported():
                 categorical += ["pallas_pack"]
+            # one-vs-two-dispatch grouped allreduce: always expressible
+            categorical += ["single_launch"]
             self.parameter_manager = ParameterManager(
                 warmup_samples=cfg.autotune_warmup_samples,
                 steps_per_sample=cfg.autotune_steps_per_sample,
@@ -92,6 +94,7 @@ class GlobalState:
                     # seed from the user's env choice so enabling autotune
                     # doesn't silently flip an explicitly-requested kernel
                     "pallas_pack": pack_pallas_enabled(),
+                    "single_launch": cfg.single_launch,
                 })
             self.engine.parameter_manager = self.parameter_manager
 
