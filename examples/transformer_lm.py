@@ -42,7 +42,15 @@ def main():
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--attention", default="ring",
                     choices=["ring", "ulysses", "flash"])
+    ap.add_argument("--sp-layout", default="contiguous",
+                    choices=["contiguous", "zigzag"],
+                    help="sequence-parallel data layout; zigzag balances "
+                         "causal ring work exactly across ranks (tokens/"
+                         "targets are permuted with zigzag_indices here)")
     ap.add_argument("--moe", action="store_true")
+    ap.add_argument("--delta-adasum", action="store_true",
+                    help="eager mode: delta-model Adasum (local optimizer "
+                         "step first, Adasum on the parameter delta)")
     args = ap.parse_args()
 
     import numpy as np
@@ -59,7 +67,8 @@ def main():
     cfg = TransformerConfig(
         vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=args.d_ff, max_seq=args.seq,
-        dtype=jnp.bfloat16, attention=args.attention, use_moe=args.moe)
+        dtype=jnp.bfloat16, attention=args.attention,
+        sp_layout=args.sp_layout, use_moe=args.moe)
     opt = optax.adamw(3e-4)
     rng = np.random.RandomState(0)
     # seq+1 raw tokens so the shifted input/target windows are exactly
@@ -81,6 +90,14 @@ def main():
         step = make_train_step(mesh, cfg, opt)
         opt_state = opt.init(params)
         tok_sh = NamedSharding(mesh, P("data", "seq"))
+        if args.sp_layout == "zigzag":
+            # zigzag data layout: the model is layout-transparent (no
+            # positional encoding; per-token loss mean is permutation-
+            # invariant), only the tokens must be permuted to match
+            from horovod_tpu.parallel import zigzag_indices
+            idx, _ = zigzag_indices(args.seq, mesh_spec.get("seq", 1))
+            inputs = jnp.take(inputs, idx, axis=1)
+            targets = jnp.take(targets, idx, axis=1)
         inputs = jax.device_put(inputs, tok_sh)
         targets = jax.device_put(targets, tok_sh)
         params, opt_state, loss = step(params, opt_state, inputs, targets)
@@ -93,7 +110,12 @@ def main():
     else:
         import horovod_tpu as hvd
         hvd.init()
-        opt = hvd.DistributedOptimizer(opt, op=hvd.Average)
+        if args.delta_adasum:
+            # delta-model Adasum (torch/optimizer.py:196-364): local
+            # optimizer step first, scale-invariant VHDD on the delta
+            opt = hvd.DistributedDeltaAdasumOptimizer(opt)
+        else:
+            opt = hvd.DistributedOptimizer(opt, op=hvd.Average)
         params = init_params(jax.random.PRNGKey(0), cfg)
         params = hvd.broadcast_parameters(params, root_rank=0)
         opt_state = opt.init(params)
