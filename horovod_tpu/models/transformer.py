@@ -20,6 +20,7 @@ scan-over-layers for compile-time scaling.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Optional, Tuple
 
 import jax
@@ -307,17 +308,24 @@ def _local_loss(params, inputs, targets, cfg, seq_size=None, tensor_size=None):
     return jnp.sum(nll), nll.size, aux
 
 
-def lean_lm_loss(params, inputs, targets, cfg: TransformerConfig):
-    """Single-shard LM loss that never materializes fp32 [B, T, V] tensors:
-    the logsumexp runs in fp32 *accumulation* over bf16 logits inside one
+def _lean_xent(logits, targets):
+    """Mean token cross-entropy without fp32 [B, T, V] temporaries: the
+    logsumexp runs in fp32 *accumulation* over bf16 logits inside one
     fusion. Measured (v5e, bench.py transformer mode): saves ~1 GB of HBM
-    temps and ~8ms/step over log_softmax-on-fp32 at V=32768."""
-    logits, aux = _forward(params, inputs, cfg, None, None, logits_f32=False)
+    temps and ~8ms/step over log_softmax-on-fp32 at V=32768. Shared by the
+    monolithic loss and the pipelined flagship so their numerics cannot
+    drift."""
     mx = jnp.max(logits, axis=-1).astype(jnp.float32)
     lse = mx + jnp.log(jnp.sum(
         jnp.exp(logits.astype(jnp.float32) - mx[..., None]), axis=-1))
     hit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    loss = jnp.mean(lse - hit.astype(jnp.float32))
+    return jnp.mean(lse - hit.astype(jnp.float32))
+
+
+def lean_lm_loss(params, inputs, targets, cfg: TransformerConfig):
+    """Single-shard LM loss built on :func:`_lean_xent`."""
+    logits, aux = _forward(params, inputs, cfg, None, None, logits_f32=False)
+    loss = _lean_xent(logits, targets)
     if cfg.use_moe:
         # same load-balancing term the SPMD loss applies (make_spmd_loss);
         # silently dropping it would let the router collapse
@@ -368,6 +376,119 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, optimizer):
     def step(params, opt_state, inputs, targets):
         loss, grads = jax.value_and_grad(
             lambda p: loss_fn(p, inputs, targets))(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+PIPE_AXIS = "pipe"
+
+
+def _pp_layer(lp, h, cfg: TransformerConfig):
+    """One dense transformer layer on a local activation block — the same
+    math as ``_forward``'s layer closure restricted to its PP-relevant
+    case (no seq/tensor collectives, dense FFN); kept in lockstep with it
+    so the pipelined flagship reproduces the monolithic numerics."""
+    dt = cfg.dtype
+    flash = cfg.attention == "flash"
+    x = _rmsnorm(h, lp["ln1"])
+    qkv_eq = "btd,dhk->bhtk" if flash else "btd,dhk->bthk"
+    q = jnp.einsum(qkv_eq, x, lp["wq"].astype(dt))
+    k = jnp.einsum(qkv_eq, x, lp["wk"].astype(dt))
+    v = jnp.einsum(qkv_eq, x, lp["wv"].astype(dt))
+    if flash:
+        att = flash_attention_local(q, k, v, causal=True, layout="bhtk")
+    else:
+        att = local_attention(q, k, v, causal=True)
+    h = h + jnp.einsum("bhtk,hkd->btd" if flash else "bthk,hkd->btd",
+                       att, lp["wo"].astype(dt))
+    x = _rmsnorm(h, lp["ln2"])
+    u = jax.nn.gelu(jnp.einsum("btd,df->btf", x, lp["w1"].astype(dt)))
+    return h + jnp.einsum("btf,fd->btd", u, lp["w2"].astype(dt))
+
+
+def pp_param_specs(cfg: TransformerConfig):
+    """Param shardings for the pipeline-parallel flagship: the stacked
+    [n_layers, ...] layer params split over the pipe axis; the (tied)
+    embedding and final norm replicated on every stage."""
+    layers = {k: P(PIPE_AXIS) for k in
+              ("ln1", "wq", "wk", "wv", "wo", "ln2", "w1", "w2")}
+    return {"embed": P(), "layers": layers, "ln_f": P()}
+
+
+def make_pp_train_step(mesh: Mesh, cfg: TransformerConfig, optimizer,
+                       n_micro: int):
+    """Pipeline-parallel flagship train step over a 1-D ``("pipe",)`` mesh
+    using the memory-bounded 1F1B schedule (parallel/pipeline.py):
+    embedding on stage 0, ``n_layers/n_stages`` transformer layers per
+    stage, final norm + tied-embedding head + lean logsumexp loss on the
+    last stage. Gradients: per-stage layer grads stay sharded over the
+    pipe axis; the tied embedding's gradient is the psum'd sum of its
+    stage-0 (lookup) and last-stage (head) contributions. Returns a jitted
+    ``(params, opt_state, inputs, targets) -> (params, opt_state, loss)``.
+
+    Beyond-reference (SURVEY §2.8: the reference has no PP); the schedule
+    keeps live activations O(n_stages) regardless of ``n_micro``."""
+    from ..parallel.pipeline import pipeline_train_1f1b, split_microbatches
+    if cfg.use_moe:
+        raise NotImplementedError("PP flagship: dense FFN only (compose "
+                                  "MoE with dp/sp/tp via make_train_step)")
+    n_stages = mesh.shape[PIPE_AXIS]
+    if cfg.n_layers % n_stages:
+        raise ValueError(f"n_layers {cfg.n_layers} must divide into "
+                         f"{n_stages} pipeline stages")
+    if cfg.remat not in ("none", "block"):
+        raise NotImplementedError(
+            f"PP flagship supports remat='none'|'block', got {cfg.remat!r}")
+    dt = cfg.dtype
+    specs = pp_param_specs(cfg)
+
+    layer_fn = functools.partial(_pp_layer, cfg=cfg)
+    if cfg.remat == "block":
+        # the 1F1B backward already recomputes each STAGE from its stashed
+        # input; remat='block' additionally checkpoints each layer inside
+        # that recompute, so a deep stage's vjp keeps one layer's
+        # activations live instead of all of them — the same lever the
+        # monolithic path uses past the B=4 memory cliff
+        layer_fn = jax.checkpoint(layer_fn, prevent_cse=False)
+
+    def stage_fn(sp, x):
+        h, _ = lax.scan(lambda h, lp: (layer_fn(lp, h), None), x, sp)
+        return h
+
+    def first_fn(fp, micro_tok):
+        return fp["embed"][micro_tok].astype(dt)
+
+    def last_fn(lp, y):
+        h = _rmsnorm(y, lp["ln_f"])
+        return jnp.einsum("btd,vd->btv", h, lp["embed"].astype(dt))
+
+    loss_fn = _lean_xent
+
+    def body(params, micro_in, micro_tgt):
+        loss, gs, gf, gl = pipeline_train_1f1b(
+            stage_fn, params["layers"], micro_in, micro_tgt, loss_fn,
+            PIPE_AXIS, n_stages,
+            first_fn=first_fn, first_params={"embed": params["embed"]},
+            last_fn=last_fn, last_params={"embed": params["embed"],
+                                          "ln_f": params["ln_f"]})
+        grads = {"embed": gf["embed"] + gl["embed"],
+                 "layers": gs, "ln_f": gl["ln_f"]}
+        return loss, grads
+
+    from ..parallel.flash_attention import flash_available
+    grad_fn = jax.shard_map(
+        body, mesh=mesh, in_specs=(specs, P(), P()),
+        out_specs=(P(), {"embed": P(), "layers": specs["layers"],
+                         "ln_f": P()}),
+        check_vma=not flash_available())
+
+    def step(params, opt_state, inputs, targets):
+        micro_in = split_microbatches(inputs, n_micro)
+        micro_tgt = split_microbatches(targets, n_micro)
+        loss, grads = grad_fn(params, micro_in, micro_tgt)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -291,10 +291,21 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, micro_inputs,
         x_b = lax.dynamic_index_in_dim(stash, jnp.mod(m_b, depth), 0,
                                        keepdims=False)
 
+        def vary_tree(t):
+            # REPLICATED params (embed/head) must be marked varying BEFORE
+            # the vjp: differentiating w.r.t. an unvarying input in a
+            # manual region makes the transpose insert an implicit psum —
+            # a collective inside a lax.switch branch only SOME ranks
+            # execute, i.e. a cross-device deadlock. Varying inputs get
+            # per-rank cotangents with no collective; the schedule's own
+            # trailing psum does the cross-stage combine.
+            return jax.tree_util.tree_map(
+                lambda a: _vary(a, axis_name), t)
+
         def b_first(_):
             _, pull = jax.vjp(
                 lambda sp, fp: stage0_composite(sp, fp, micro_b),
-                stage_params, first_params)
+                stage_params, vary_tree(first_params))
             dgs, dgf = pull(bwd_in)
             return (dgs, dgf, zeros_like_tree(last_params), act0,
                     _zero_loss())
@@ -310,7 +321,7 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, micro_inputs,
             # previous tick); loss seeds the cotangent chain
             loss_m, pull = jax.vjp(
                 lambda sp, lp, x: last_composite(sp, lp, x, tgt_b),
-                stage_params, last_params, fwd_in)
+                stage_params, vary_tree(last_params), fwd_in)
             dgs, dgl, dx = pull(jnp.ones_like(loss_m))
             return (dgs, zeros_like_tree(first_params), dgl, dx,
                     loss_m.astype(jnp.float32))
