@@ -386,11 +386,12 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, optimizer):
 PIPE_AXIS = "pipe"
 
 
-def _pp_layer(lp, h, cfg: TransformerConfig):
+def _pp_layer(lp, h, cfg: TransformerConfig, under_remat: bool = False):
     """One dense transformer layer on a local activation block — the same
     math as ``_forward``'s layer closure restricted to its PP-relevant
     case (no seq/tensor collectives, dense FFN); kept in lockstep with it
-    so the pipelined flagship reproduces the monolithic numerics."""
+    so the pipelined flagship reproduces the monolithic numerics,
+    including the under-remat splash→flash VMEM degrade."""
     dt = cfg.dtype
     flash = cfg.attention == "flash"
     x = _rmsnorm(h, lp["ln1"])
@@ -399,7 +400,8 @@ def _pp_layer(lp, h, cfg: TransformerConfig):
     k = jnp.einsum(qkv_eq, x, lp["wk"].astype(dt))
     v = jnp.einsum(qkv_eq, x, lp["wv"].astype(dt))
     if flash:
-        att = flash_attention_local(q, k, v, causal=True, layout="bhtk")
+        att = flash_attention_local(q, k, v, causal=True, layout="bhtk",
+                                    under_remat=under_remat)
     else:
         att = local_attention(q, k, v, causal=True)
     h = h + jnp.einsum("bhtk,hkd->btd" if flash else "bthk,hkd->btd",
@@ -445,11 +447,14 @@ def make_pp_train_step(mesh: Mesh, cfg: TransformerConfig, optimizer,
     dt = cfg.dtype
     specs = pp_param_specs(cfg)
 
-    layer_fn = functools.partial(_pp_layer, cfg=cfg)
+    # the 1F1B backward ALWAYS recomputes each stage from its stashed
+    # input, so the attention kernels run under recompute regardless of
+    # cfg.remat — the splash→flash VMEM degrade must apply here just as
+    # in _forward
+    layer_fn = functools.partial(_pp_layer, cfg=cfg, under_remat=True)
     if cfg.remat == "block":
-        # the 1F1B backward already recomputes each STAGE from its stashed
-        # input; remat='block' additionally checkpoints each layer inside
-        # that recompute, so a deep stage's vjp keeps one layer's
+        # remat='block' additionally checkpoints each layer inside the
+        # stage recompute, so a deep stage's vjp keeps one layer's
         # activations live instead of all of them — the same lever the
         # monolithic path uses past the B=4 memory cliff
         layer_fn = jax.checkpoint(layer_fn, prevent_cse=False)

@@ -1,0 +1,71 @@
+// ThreadSanitizer stress driver for the native timeline writer.
+//
+// SURVEY §5 (race detection): the reference relies on a single
+// communication-owner thread plus mutexes and ships no sanitizer CI; the
+// TPU build's concurrency-bearing native component is this writer (hot
+// enqueue from many Python threads, dedicated drain thread, open/close
+// lifecycle racing producers). This binary hammers exactly those edges and
+// is built with -fsanitize=thread in CI (tests/test_timeline.py builds and
+// runs it wherever g++ is available) — a data race or deadlock fails the
+// run.
+//
+// Scenarios:
+//   1. N producer threads x M events against one open file.
+//   2. Producers still running while Close() drains and joins (the API
+//      allows late events; they must be safe, landing in the queue for a
+//      potential later Open).
+//   3. Repeated open/close cycles with concurrent producers.
+
+#include <cstdint>
+#include <cstdio>
+#include <thread>
+#include <vector>
+
+extern "C" {
+int hvd_timeline_open(const char* path);
+void hvd_timeline_event(char ph, const char* name, int64_t ts_us,
+                        int64_t dur_us, int64_t tid, const char* args_json);
+void hvd_timeline_close();
+}
+
+namespace {
+
+void Produce(int tid, int n_events) {
+  for (int i = 0; i < n_events; ++i) {
+    hvd_timeline_event('X', "stress.tensor", i * 10, 5, tid,
+                       i % 3 ? "" : "{\"bytes\":4096}");
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* path = argc > 1 ? argv[1] : "/tmp/hvd_timeline_stress.json";
+  const int kThreads = 8;
+  const int kEvents = 5000;
+
+  for (int cycle = 0; cycle < 3; ++cycle) {
+    if (hvd_timeline_open(path) != 0) {
+      std::fprintf(stderr, "open failed (cycle %d)\n", cycle);
+      return 1;
+    }
+    std::vector<std::thread> producers;
+    producers.reserve(kThreads);
+    for (int t = 0; t < kThreads; ++t) {
+      producers.emplace_back(Produce, t, kEvents);
+    }
+    // close races the tail of the producers on odd cycles: Close() must
+    // drain what was enqueued and tolerate late Push calls
+    if (cycle % 2) {
+      for (int t = 0; t < kThreads / 2; ++t) producers[t].join();
+      std::thread closer([] { hvd_timeline_close(); });
+      for (int t = kThreads / 2; t < kThreads; ++t) producers[t].join();
+      closer.join();
+    } else {
+      for (auto& p : producers) p.join();
+      hvd_timeline_close();
+    }
+  }
+  std::puts("timeline stress OK");
+  return 0;
+}
