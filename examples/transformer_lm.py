@@ -1,13 +1,18 @@
 """Flagship transformer-LM training example.
 
-Two modes:
+Three modes:
 
-- ``--spmd`` (default): the TPU-idiomatic path — one process, all chips,
-  the whole train step shard_mapped over a (data, seq, tensor) mesh built
-  from ``--mesh data=2,seq=2,tensor=2`` (axes riding DCN go first; see
-  ``horovod_tpu.parallel.mesh.multislice_mesh`` for multi-slice pods).
-- ``--eager``: the Horovod-style path — one process per chip under
-  ``tpurun``, gradients reduced through ``hvd.DistributedOptimizer``.
+- ``--mode spmd`` (default): the TPU-idiomatic path — one process, all
+  chips, the whole train step shard_mapped over a (data, seq, tensor)
+  mesh built from ``--mesh data=2,seq=2,tensor=2`` (axes riding DCN go
+  first; see ``horovod_tpu.parallel.mesh.multislice_mesh`` for
+  multi-slice pods). ``--sp-layout zigzag`` load-balances the causal
+  ring.
+- ``--mode eager``: the Horovod-style path — one process per chip under
+  ``tpurun``, gradients reduced through ``hvd.DistributedOptimizer``
+  (``--delta-adasum`` for the delta-model Adasum form).
+- ``--mode pp``: the flagship through the memory-bounded 1F1B pipeline
+  (``--stages``, ``--n-micro``).
 
 Synthetic data; prints tokens/sec. Mirrors the reference's synthetic
 benchmark scripts (examples/*_synthetic_benchmark.py) for the LM workload.
@@ -29,7 +34,12 @@ def parse_mesh(spec: str) -> dict:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=["spmd", "eager"], default="spmd")
+    ap.add_argument("--mode", choices=["spmd", "eager", "pp"],
+                    default="spmd")
+    ap.add_argument("--stages", type=int, default=None,
+                    help="pp mode: pipeline stages (default: all devices)")
+    ap.add_argument("--n-micro", type=int, default=4,
+                    help="pp mode: microbatches per step")
     ap.add_argument("--mesh", default=None,
                     help="e.g. data=2,seq=2,tensor=2 (spmd mode)")
     ap.add_argument("--d-model", type=int, default=512)
@@ -77,7 +87,29 @@ def main():
     inputs = jnp.asarray(tokens[:, :-1])
     targets = jnp.asarray(tokens[:, 1:])
 
-    if args.mode == "spmd":
+    if args.mode == "pp":
+        # the flagship through the memory-bounded 1F1B pipeline: embedding
+        # on stage 0, n_layers/stages layers per stage, tied-embedding
+        # head + lean loss on the last stage (docs/parallelism.md)
+        from jax.sharding import Mesh
+        from horovod_tpu.models.transformer import (make_pp_train_step,
+                                                    pp_param_specs)
+        n_stages = args.stages or len(jax.devices())
+        mesh = Mesh(np.array(jax.devices()[:n_stages]), ("pipe",))
+        specs = pp_param_specs(cfg)
+        params = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            init_params(jax.random.PRNGKey(0), cfg), specs)
+        step = make_pp_train_step(mesh, cfg, opt, n_micro=args.n_micro)
+        opt_state = opt.init(params)
+        params, opt_state, loss = step(params, opt_state, inputs, targets)
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            params, opt_state, loss = step(params, opt_state, inputs,
+                                           targets)
+        loss = float(loss)
+        dt = (time.perf_counter() - t0) / args.steps
+    elif args.mode == "spmd":
         from horovod_tpu.parallel.mesh import training_mesh
         # the flagship step names all three axes; absent ones get size 1
         mesh_spec = {"data": len(jax.devices()), "seq": 1, "tensor": 1}

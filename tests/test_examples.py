@@ -135,6 +135,16 @@ def test_transformer_lm_example_eager():
     assert "tokens_per_sec" in r.stdout, r.stdout
 
 
+def test_transformer_lm_example_pp():
+    r = _run([os.path.join(EXAMPLES, "transformer_lm.py"),
+              "--mode", "pp", "--stages", "2", "--n-micro", "4",
+              "--d-model", "32", "--n-layers", "2",
+              "--n-heads", "4", "--d-ff", "64", "--vocab", "128",
+              "--seq", "32", "--batch", "4", "--steps", "2"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "tokens_per_sec" in r.stdout, r.stdout
+
+
 def test_sparse_embedding_example():
     r = _run([os.path.join(EXAMPLES, "sparse_embedding.py"),
               "--steps", "10", "--vocab", "5000"])
