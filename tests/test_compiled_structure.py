@@ -190,3 +190,33 @@ def test_grouped_allreduce_single_launch_one_program():
     # fusion-buffer guarantee — the bound is what bucketing promises)
     assert 1 <= n_ar <= 2, \
         f"expected <= one all-reduce per bucket (2), got {n_ar}"
+
+
+def test_grouped_allreduce_hierarchical_ladder():
+    """The single-launch grouped program with local_size=4 must lower each
+    bucket's reduction to the hierarchical RS/AG ladder with node-local
+    replica groups — the same structural bar the per-bucket fused program
+    meets — AND produce numerically correct sums."""
+    import re
+    mesh = _world_mesh()
+    shapes = tuple((32,) for _ in range(4))
+    buckets = [[0, 1], [2, 3]]
+    fn = C.build_grouped_allreduce(mesh, "world", ReduceOp.SUM, shapes,
+                                   [jnp.float32] * 4, buckets,
+                                   local_size=4)
+    rng = np.random.RandomState(0)
+    data = [rng.randn(8, 64).astype(np.float32) for _ in buckets]
+    args = [jax.device_put(jnp.asarray(d),
+                           NamedSharding(mesh, P("world")))
+            for d in data]
+    hlo = _hlo(fn, *args)
+    local_groups = re.search(r"replica_groups=\{\{0,1,2,3\},\{4,5,6,7\}\}",
+                             hlo.replace(" ", ""))
+    assert local_groups, "no node-local replica groups in grouped ladder"
+    outs = fn(*args)
+    for b, idxs in enumerate(buckets):
+        expect = data[b].sum(axis=0)
+        np.testing.assert_allclose(np.asarray(outs[idxs[0]]), expect[:32],
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(outs[idxs[1]]), expect[32:],
+                                   rtol=1e-5, atol=1e-5)
