@@ -12,7 +12,7 @@ recover any of the overlap gap. Run once per flag set:
     XLA_FLAGS="--xla_tpu_scoped_vmem_limit_kib=65536" \
         python tools/probe_resnet_overlap.py
 
-Prints one line: flags + mean step ms (dependent-steps timing, tunnel RTT
+Prints one line: flags + median step ms (dependent-steps timing, tunnel RTT
 subtracted) so runs can be compared across the shared-chip noise band
 (repeat >= 2x per flag set).
 """
@@ -71,7 +71,8 @@ def main():
     lowered = jax.jit(step_fn).lower(*state, images, labels)
     step = (lowered.compile(compiler_options=copts) if copts
             else lowered.compile())
-    dt, rtt = _time_steps(step, state, (images, labels), iters)
+    dt, rtt, _spread = _time_steps(step, state, (images, labels),
+                                   iters)
     print(f"opts={opts_env!r} "
           f"step_ms={dt * 1e3:.2f} rtt_ms={rtt * 1e3:.1f} "
           f"img_s={batch / dt:.1f}", flush=True)
