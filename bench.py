@@ -219,8 +219,10 @@ def _measure_lm(cfg, B):
     def run_loss(iters, st):
         return run(iters, st)[1]
 
-    # span: 4 extra steps x ~120-250 ms/step >= ~500 ms >> tunnel noise
-    dt, spread, n_used = _marginal_median(run_loss, st0, 2, 6)
+    # span: 4 extra steps x ~120-250 ms/step >= ~500 ms >> tunnel noise;
+    # 5 reps — a rep costs ~1 s and a single co-tenant burst otherwise
+    # blows the reported spread
+    dt, spread, n_used = _marginal_median(run_loss, st0, 2, 6, reps=5)
 
     import jax.tree_util as jtu
     n_params = sum(int(np.prod(v.shape)) for v in jtu.tree_leaves(params))
