@@ -422,14 +422,18 @@ def pp_param_specs(cfg: TransformerConfig):
 
 def make_pp_train_step(mesh: Mesh, cfg: TransformerConfig, optimizer,
                        n_micro: int):
-    """Pipeline-parallel flagship train step over a 1-D ``("pipe",)`` mesh
-    using the memory-bounded 1F1B schedule (parallel/pipeline.py):
-    embedding on stage 0, ``n_layers/n_stages`` transformer layers per
-    stage, final norm + tied-embedding head + lean logsumexp loss on the
-    last stage. Gradients: per-stage layer grads stay sharded over the
-    pipe axis; the tied embedding's gradient is the psum'd sum of its
-    stage-0 (lookup) and last-stage (head) contributions. Returns a jitted
-    ``(params, opt_state, inputs, targets) -> (params, opt_state, loss)``.
+    """Pipeline-parallel flagship train step over a ``("pipe",)`` mesh —
+    or a 2-D ``("data", "pipe")`` mesh for DP×PP composition — using the
+    memory-bounded 1F1B schedule (parallel/pipeline.py): embedding on
+    stage 0, ``n_layers/n_stages`` transformer layers per stage, final
+    norm + tied-embedding head + lean logsumexp loss on the last stage.
+    Gradients: per-stage layer grads stay sharded over the pipe axis; the
+    tied embedding's gradient is the psum'd sum of its stage-0 (lookup)
+    and last-stage (head) contributions; under DP every gradient is
+    additionally pmean'd over the data axis (the reference's allreduce,
+    realized as the pipeline replica reduction). Returns a jitted
+    ``(params, opt_state, inputs, targets) -> (params, opt_state, loss)``
+    where inputs/targets carry the GLOBAL batch (split over data).
 
     Beyond-reference (SURVEY §2.8: the reference has no PP); the schedule
     keeps live activations O(n_stages) regardless of ``n_micro``."""
@@ -437,6 +441,7 @@ def make_pp_train_step(mesh: Mesh, cfg: TransformerConfig, optimizer,
     if cfg.use_moe:
         raise NotImplementedError("PP flagship: dense FFN only (compose "
                                   "MoE with dp/sp/tp via make_train_step)")
+    d_size = mesh.shape.get(DATA_AXIS, 1)
     n_stages = mesh.shape[PIPE_AXIS]
     if cfg.n_layers % n_stages:
         raise ValueError(f"n_layers {cfg.n_layers} must divide into "
@@ -472,7 +477,11 @@ def make_pp_train_step(mesh: Mesh, cfg: TransformerConfig, optimizer,
 
     loss_fn = _lean_xent
 
-    def body(params, micro_in, micro_tgt):
+    def body(params, inputs, targets):
+        # inputs/targets arrive as this data-shard's slice of the global
+        # batch; microbatching happens per replica
+        micro_in = split_microbatches(inputs, n_micro)
+        micro_tgt = split_microbatches(targets, n_micro)
         loss, gs, gf, gl = pipeline_train_1f1b(
             stage_fn, params["layers"], micro_in, micro_tgt, loss_fn,
             PIPE_AXIS, n_stages,
@@ -481,19 +490,24 @@ def make_pp_train_step(mesh: Mesh, cfg: TransformerConfig, optimizer,
                                           "ln_f": params["ln_f"]})
         grads = {"embed": gf["embed"] + gl["embed"],
                  "layers": gs, "ln_f": gl["ln_f"]}
+        if d_size > 1:
+            # DP x PP: average replicas' grads + loss over the data axis
+            # (the reference's gradient allreduce)
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, DATA_AXIS), grads)
+            loss = lax.pmean(loss, DATA_AXIS)
         return loss, grads
 
     from ..parallel.flash_attention import flash_available
+    tok_spec = P(DATA_AXIS) if d_size > 1 else P()
     grad_fn = jax.shard_map(
-        body, mesh=mesh, in_specs=(specs, P(), P()),
+        body, mesh=mesh, in_specs=(specs, tok_spec, tok_spec),
         out_specs=(P(), {"embed": P(), "layers": specs["layers"],
                          "ln_f": P()}),
         check_vma=not flash_available())
 
     def step(params, opt_state, inputs, targets):
-        micro_in = split_microbatches(inputs, n_micro)
-        micro_tgt = split_microbatches(targets, n_micro)
-        loss, grads = grad_fn(params, micro_in, micro_tgt)
+        loss, grads = grad_fn(params, inputs, targets)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

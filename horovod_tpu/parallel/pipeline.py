@@ -158,11 +158,28 @@ def pipeline_apply_p(stage_fn: Callable, stage_params, micro_inputs,
     return broadcast_p(outputs, axis_name, root_rank=last)
 
 
-def _vary(x, axis_name):
-    """Mark constants varying over the pipe axis (shard_map VMA typing);
-    no-op outside manual regions / on older jax."""
+def _vma_of(x):
+    """The set of manual axes ``x`` is varying over (empty outside manual
+    regions / on older jax)."""
     try:
-        return lax.pcast(x, (axis_name,), to="varying")
+        return set(jax.typeof(x).vma)
+    except Exception:
+        return set()
+
+
+def _vary(x, axes):
+    """Mark ``x`` varying over ``axes`` (a name or tuple of names —
+    shard_map VMA typing); only the axes it is not ALREADY varying over
+    are cast (pcast rejects re-varying an axis, and a blanket try/except
+    would then silently skip the whole cast). No-op outside manual
+    regions / on older jax."""
+    if isinstance(axes, str):
+        axes = (axes,)
+    need = tuple(a for a in axes if a not in _vma_of(x))
+    if not need:
+        return x
+    try:
+        return lax.pcast(x, need, to="varying")
     except Exception:
         return x
 
@@ -229,12 +246,25 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, micro_inputs,
     if last_params is None:
         last_params = ()
 
+    # The schedule's internal constants (zero activations, stash, grad
+    # accumulators) must be varying over the UNION of the manual axes its
+    # data varies over — under a composed (data, pipe) mesh the inputs
+    # carry data-varying and the stage computation adds pipe-varying, so
+    # varying over pipe alone mistypes every cond/switch branch.
+    vary_axes = {axis_name}
+    for leaf in jax.tree_util.tree_leaves(
+            (micro_inputs, micro_targets, stage_params, first_params,
+             last_params)):
+        vary_axes |= _vma_of(leaf)
+    vary_axes = tuple(sorted(vary_axes))
+
     # activation struct probing (the ring is shape-uniform)
     if has_first:
         act_struct = jax.eval_shape(first_fn, first_params, micro_inputs[0])
     else:
         act_struct = jax.eval_shape(lambda x: x, micro_inputs[0])
-    act0 = _vary(jnp.zeros(act_struct.shape, act_struct.dtype), axis_name)
+    act0 = _vary(jnp.zeros(act_struct.shape, act_struct.dtype),
+                 vary_axes)
 
     def stage0_composite(sp, fp, micro):
         x = first_fn(fp, micro) if has_first else micro.astype(act0.dtype)
@@ -247,10 +277,10 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, micro_inputs,
 
     def zeros_like_tree(t):
         return jax.tree_util.tree_map(
-            lambda a: _vary(jnp.zeros(a.shape, a.dtype), axis_name), t)
+            lambda a: _vary(jnp.zeros(a.shape, a.dtype), vary_axes), t)
 
     def _zero_loss():
-        return _vary(jnp.zeros((), jnp.float32), axis_name)
+        return _vary(jnp.zeros((), jnp.float32), vary_axes)
 
     def tick(carry, t):
         fwd_in, bwd_in, stash, gs, gf, gl, loss_acc = carry
@@ -292,26 +322,28 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, micro_inputs,
                                        keepdims=False)
 
         def vary_tree(t):
-            # REPLICATED params (embed/head) must be marked varying BEFORE
-            # the vjp: differentiating w.r.t. an unvarying input in a
-            # manual region makes the transpose insert an implicit psum —
-            # a collective inside a lax.switch branch only SOME ranks
-            # execute, i.e. a cross-device deadlock. Varying inputs get
-            # per-rank cotangents with no collective; the schedule's own
-            # trailing psum does the cross-stage combine.
+            # Params must be marked FULLY varying (over every manual axis
+            # the data varies over) BEFORE the vjp: differentiating w.r.t.
+            # an input unvarying over some axis makes the transpose insert
+            # an implicit psum over that axis — inside a lax.switch branch
+            # only SOME ranks execute, i.e. a cross-device deadlock (and
+            # under a composed data axis, a premature replica combine).
+            # Varying inputs get per-rank cotangents with no collective;
+            # the schedule's trailing psum (and the caller's data-axis
+            # pmean) do the combines explicitly.
             return jax.tree_util.tree_map(
-                lambda a: _vary(a, axis_name), t)
+                lambda a: _vary(a, vary_axes), t)
 
         def b_first(_):
             _, pull = jax.vjp(
                 lambda sp, fp: stage0_composite(sp, fp, micro_b),
-                stage_params, vary_tree(first_params))
+                vary_tree(stage_params), vary_tree(first_params))
             dgs, dgf = pull(bwd_in)
             return (dgs, dgf, zeros_like_tree(last_params), act0,
                     _zero_loss())
 
         def b_mid(_):
-            _, pull = jax.vjp(stage_fn, stage_params, x_b)
+            _, pull = jax.vjp(stage_fn, vary_tree(stage_params), x_b)
             dgs, dx = pull(bwd_in)
             return (dgs, zeros_like_tree(first_params),
                     zeros_like_tree(last_params), dx, _zero_loss())
@@ -321,7 +353,7 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, micro_inputs,
             # previous tick); loss seeds the cotangent chain
             loss_m, pull = jax.vjp(
                 lambda sp, lp, x: last_composite(sp, lp, x, tgt_b),
-                stage_params, vary_tree(last_params), fwd_in)
+                vary_tree(stage_params), vary_tree(last_params), fwd_in)
             dgs, dgl, dx = pull(jnp.ones_like(loss_m))
             return (dgs, zeros_like_tree(first_params), dgl, dx,
                     loss_m.astype(jnp.float32))
@@ -349,7 +381,7 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, micro_inputs,
         return (fwd_in, bwd_in, stash, gs, gf, gl, loss_acc), None
 
     stash0 = _vary(jnp.zeros((depth,) + tuple(act_struct.shape),
-                             act_struct.dtype), axis_name)
+                             act_struct.dtype), vary_axes)
     carry0 = (act0, act0, stash0,
               zeros_like_tree(stage_params), zeros_like_tree(first_params),
               zeros_like_tree(last_params), _zero_loss())

@@ -357,3 +357,47 @@ def test_pp_flagship_matches_single_device(remat):
         np.testing.assert_allclose(np.asarray(p_pp["layers"][k]),
                                    np.asarray(p_ref["layers"][k]),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_pp_flagship_composes_with_dp():
+    """DP x PP over a (data=2, pipe=4) mesh: the global batch splits over
+    the data axis, each replica pipelines its half, gradients pmean over
+    data — the result must match the single-device full-batch step."""
+    import optax
+    from horovod_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                n_layers=4, d_ff=64, max_seq=16,
+                                dtype=jnp.float32, attention="flash")
+    params = tfm.init_params(jax.random.PRNGKey(1), cfg)
+    rng = np.random.RandomState(12)
+    inputs = jnp.asarray(rng.randint(0, 64, size=(8, 16)).astype(np.int32))
+    targets = jnp.asarray(rng.randint(0, 64, size=(8, 16)).astype(np.int32))
+
+    opt = optax.sgd(0.1)
+    l_ref, g_ref = jax.value_and_grad(
+        lambda p: tfm.lean_lm_loss(p, inputs, targets, cfg))(params)
+    up, _ = opt.update(g_ref, opt.init(params), params)
+    p_ref = optax.apply_updates(params, up)
+
+    mesh = jax.sharding.Mesh(
+        np.array(jax.devices()[:8]).reshape(2, 4),
+        (tfm.DATA_AXIS, tfm.PIPE_AXIS))
+    specs = tfm.pp_param_specs(cfg)
+    sharded = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params,
+        specs)
+    step = tfm.make_pp_train_step(mesh, cfg, optax.sgd(0.1), n_micro=2)
+    tok_sh = NamedSharding(mesh, P(tfm.DATA_AXIS))
+    p_pp, _, l_pp = step(sharded, optax.sgd(0.1).init(sharded),
+                         jax.device_put(inputs, tok_sh),
+                         jax.device_put(targets, tok_sh))
+    np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-5)
+    for k in ("embed", "ln_f"):
+        np.testing.assert_allclose(np.asarray(p_pp[k]),
+                                   np.asarray(p_ref[k]), rtol=1e-4,
+                                   atol=1e-5)
+    for k in p_ref["layers"]:
+        np.testing.assert_allclose(np.asarray(p_pp["layers"][k]),
+                                   np.asarray(p_ref["layers"][k]),
+                                   rtol=1e-4, atol=1e-5)
