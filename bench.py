@@ -356,10 +356,24 @@ def bench_sp_ring():
             # seconds on the tunnel and swamp the timing
             return jnp.sum(st[0][0, 0, 0].astype(jnp.float32))
 
-        # ~10 ms/step x 40-step span >= ~400 ms >> tunnel noise; 5 reps
-        # (vs 3 elsewhere): these sections' spreads are what the driver
-        # checks for reproducibility, and a rep here costs only ~1 s
-        return _marginal_median(run, st0, 4, 44, reps=5)
+        # Adaptive span (r5: the driver's SP-ring spread hit 24.8% while
+        # the fixed 40-step span sat right at the ~400 ms noise floor):
+        # probe the marginal per-step cost once, then size the span so each
+        # marginal covers >= ~600 ms of device time. Quantized to multiples
+        # of 20 steps so the persistent compilation cache stays warm across
+        # runs despite probe jitter; median of 5 with the spread reported,
+        # as before.
+        for it in (4, 24):
+            _fetch_scalar(run(it, st0))
+        t0 = time.perf_counter()
+        _fetch_scalar(run(4, st0))
+        d4 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _fetch_scalar(run(24, st0))
+        d24 = time.perf_counter() - t0
+        est = max((d24 - d4) / 20.0, 1e-4)
+        span = min(max(40, int(round(0.6 / est / 20.0)) * 20), 400)
+        return _marginal_median(run, st0, 4, 4 + span, reps=5)
 
     out = {}
     dt, spread, n_used = measure(
@@ -408,9 +422,9 @@ def main():
     import jax.numpy as jnp
     import optax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from jax import shard_map
 
-    import horovod_tpu as hvd
+    import horovod_tpu as hvd  # installs the jax compat shims first
+    from jax import shard_map
     from horovod_tpu import optimizer as hvd_opt
     from horovod_tpu.models.resnet import ResNet50
 
@@ -535,10 +549,74 @@ def main():
         eager_step, (params, batch_stats, eager_opt_state),
         (images, labels), max(iters // 2, 4))
 
+    def _engine_dispatches(step_fn, state):
+        """Engine-issued XLA launches in one step (the dispatch-count side
+        of the eager-gap attribution)."""
+        d0 = eng.dispatch_count
+        step_fn(*state, images, labels)
+        return eng.dispatch_count - d0
+
+    eager_disp = _engine_dispatches(
+        eager_step, (params, batch_stats, eager_opt_state))
+
+    # ---- eager path under step-capture replay -----------------------------
+    # Identical step, but bracketed by step_begin/step_end: after
+    # HOROVOD_TPU_STEP_REPLAY_WARMUP identical steps (inside _time_steps'
+    # warmups) the engine services the whole grouped reduction as ONE fused
+    # launch (core/replay.py) — the automatic form of the hand-driven
+    # grouped path above, and the dispatch-stream share of the eager gap.
+    replay_opt_state = eager_opt.init(params)
+    replay_step_i = [0]
+
+    def eager_replay_step(params, batch_stats, opt_state, images, labels):
+        (loss, new_bs), grads = grad_fn(params, batch_stats, images, labels)
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        eng.step_begin()
+        handles = eng.grouped_allreduce(
+            leaves, name=f"bench.replay.grad.{replay_step_i[0]}",
+            op=hvd.Average if hvd.size() > 1 else hvd.Sum)
+        replay_step_i[0] += 1
+        reduced = jax.tree_util.tree_unflatten(
+            treedef, [h.result() for h in handles])
+        eng.step_end()
+        params, opt_state = apply_fn(params, opt_state, reduced)
+        return params, new_bs, opt_state, loss
+
+    replay_dt, _, replay_spread = _time_steps(
+        eager_replay_step, (params, batch_stats, replay_opt_state),
+        (images, labels), max(iters // 2, 4))
+    replay_disp = _engine_dispatches(
+        eager_replay_step, (params, batch_stats, replay_opt_state))
+    replay_counters = {
+        "replayed_steps": eng.replay.replayed_steps,
+        "captured_streams": eng.replay.captured_streams,
+        "fallbacks": eng.replay.fallbacks,
+    }
+
     # ---- report -----------------------------------------------------------
     spmd_img_s = batch / spmd_dt
     raw_img_s = batch / raw_dt
     eager_img_s = batch / eager_dt
+    replay_img_s = batch / replay_dt
+    # dispatch-count attribution of the eager gap (ISSUE r5 acceptance):
+    # replay removes the per-step engine dispatch stream (pack + launch +
+    # Python bookkeeping -> one fused launch); what it removes in wall
+    # clock is the dispatch-stream share of the eager-vs-SPMD gap, the
+    # 16% VERDICT r5 left unattributed.
+    eager_gap = eager_dt - spmd_dt
+    gap_attribution = {
+        "spmd_step_ms": round(spmd_dt * 1e3, 3),
+        "eager_step_ms": round(eager_dt * 1e3, 3),
+        "eager_replay_step_ms": round(replay_dt * 1e3, 3),
+        "eager_gap_ms": round(eager_gap * 1e3, 3),
+        "dispatch_stream_ms": round((eager_dt - replay_dt) * 1e3, 3),
+        "residual_ms": round((replay_dt - spmd_dt) * 1e3, 3),
+        "dispatch_stream_pct_of_gap": (
+            round((eager_dt - replay_dt) / eager_gap * 100.0, 1)
+            if abs(eager_gap) > 1e-9 else None),
+        "eager_engine_dispatches_per_step": eager_disp,
+        "replay_engine_dispatches_per_step": replay_disp,
+    }
     tflops_chip = flops_per_chip / spmd_dt / 1e12
     peak = _chip_peak_tflops(jax.devices()[0])
     img_s_chip = spmd_img_s / n_chips
@@ -568,6 +646,11 @@ def main():
                                          (spmd_dt - raw_dt) / raw_dt * 100), 2),
         "eager_img_s_per_chip": round(eager_img_s / n_chips, 2),
         "eager_spread_pct": round(eager_spread, 1),
+        "eager_replay_img_s_per_chip": round(replay_img_s / n_chips, 2),
+        "eager_replay_spread_pct": round(replay_spread, 1),
+        "eager_replay_vs_spmd": round(replay_img_s / spmd_img_s, 3),
+        "replay_counters": replay_counters,
+        "eager_gap_attribution": gap_attribution,
         "spmd_spread_pct": round(spmd_spread, 1),
         "achieved_tflops_per_chip": round(tflops_chip, 2),
         "mfu_pct": (round(100.0 * tflops_chip / peak, 2)

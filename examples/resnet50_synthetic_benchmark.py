@@ -22,9 +22,9 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
-import horovod_tpu as hvd
+import horovod_tpu as hvd  # installs the jax compat shims first
+from jax import shard_map
 from horovod_tpu import optimizer as hvd_opt
 from horovod_tpu.models.resnet import ResNet50
 

@@ -45,8 +45,10 @@ def main():
     import jax
     import jax.numpy as jnp
     import optax
-    from jax import shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import horovod_tpu  # installs the jax compat shims first
+    from jax import shard_map
 
     from horovod_tpu import optimizer as hvd_opt
     from horovod_tpu.common.reduce_ops import Average, ReduceOp

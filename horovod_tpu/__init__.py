@@ -17,6 +17,7 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+from . import compat as _compat  # noqa: F401  (jax version shims, first)
 from .common.reduce_ops import (ReduceOp, Average, Sum, Adasum, Min, Max, Product,
                                 handle_average_backwards_compatibility)
 from .common.exceptions import (HorovodInternalError, HostsUpdatedInterrupt,
@@ -269,6 +270,9 @@ broadcast_object = _functions.broadcast_object
 allgather_object = _functions.allgather_object
 allreduce_sparse = _functions.allreduce_sparse
 broadcast_optimizer_state = _functions.broadcast_optimizer_state
+step_begin = _functions.step_begin
+step_end = _functions.step_end
+step = _functions.step
 from . import elastic  # noqa: E402
 
 __all__ = [
@@ -278,6 +282,7 @@ __all__ = [
     "allgather", "allgather_async", "broadcast", "broadcast_async",
     "alltoall", "alltoall_async", "reducescatter", "reducescatter_async",
     "barrier", "join", "poll", "synchronize", "step_heartbeat",
+    "step_begin", "step_end", "step",
     "broadcast_parameters", "broadcast_object", "allgather_object",
     "allreduce_sparse",
     "broadcast_optimizer_state",

@@ -24,6 +24,10 @@ packing) that a static default cannot make per cluster:
 - pallas_pack (offered when Pallas is available)
 - single_launch (one-vs-two-dispatch grouped allreduce; the best choice
   depends on dispatch overhead vs pack-fusion quality per runtime)
+- step_replay (step-capture replay, core/replay.py: whether fusing the
+  whole steady-state step into one launch beats the grouped path is a
+  per-runtime dispatch-overhead fact, so it tunes like the other
+  topology-dependent on/off choices)
 
 Scoring: the interval between successive ``step_mark`` calls spans one
 full training step (mark fires at grouped-allreduce entry each step), so

@@ -75,6 +75,11 @@ HOROVOD_TPU_META_CACHE = "HOROVOD_TPU_META_CACHE"
 # in a single jitted program); =0 restores the per-bucket two-dispatch form
 HOROVOD_TPU_SINGLE_LAUNCH = "HOROVOD_TPU_SINGLE_LAUNCH"
 HOROVOD_TPU_META_CACHE_WARMUP = "HOROVOD_TPU_META_CACHE_WARMUP"
+# step-capture replay (core/replay.py): record the dispatch stream between
+# hvd.step_begin()/step_end() and, once the same signature repeats WARMUP
+# times, service the whole step with one fused XLA launch; =0 disables
+HOROVOD_TPU_STEP_REPLAY = "HOROVOD_TPU_STEP_REPLAY"
+HOROVOD_TPU_STEP_REPLAY_WARMUP = "HOROVOD_TPU_STEP_REPLAY_WARMUP"
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # operations.cc:432
 DEFAULT_CYCLE_TIME_MS = 5.0                        # operations.cc:440
@@ -136,6 +141,8 @@ class Config:
     meta_cache: bool = True
     meta_cache_warmup: int = 2
     single_launch: bool = True
+    step_replay: bool = True
+    step_replay_warmup: int = 3
     extra: dict = field(default_factory=dict)
 
     @classmethod
@@ -167,4 +174,6 @@ class Config:
             meta_cache=_get_bool(HOROVOD_TPU_META_CACHE, True),
             meta_cache_warmup=_get_int(HOROVOD_TPU_META_CACHE_WARMUP, 2),
             single_launch=_get_bool(HOROVOD_TPU_SINGLE_LAUNCH, True),
+            step_replay=_get_bool(HOROVOD_TPU_STEP_REPLAY, True),
+            step_replay_warmup=_get_int(HOROVOD_TPU_STEP_REPLAY_WARMUP, 3),
         )

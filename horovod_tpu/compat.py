@@ -1,0 +1,59 @@
+"""Version-compatibility shims for the jax surface this package targets.
+
+The package is written against the current jax API where ``shard_map`` is
+top-level and its replication checker is spelled ``check_vma``. On older
+jax (< 0.5) the same machinery lives at
+``jax.experimental.shard_map.shard_map`` with the checker spelled
+``check_rep``. Importing this module first makes both spellings work:
+``jax.shard_map`` is aliased (so call sites and tests using the modern
+spelling run unchanged) and ``check_vma`` is translated.
+
+No behavior changes on modern jax — every shim is gated on the attribute
+being absent.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+if not hasattr(jax, "shard_map"):  # jax < 0.5: experimental spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kw):
+        # The legacy check_rep validator predates the VMA type system and
+        # spuriously rejects code the modern checker accepts (e.g. psum
+        # inside cond branches — the error itself recommends
+        # check_rep=False). It is validation-only (no numeric effect), so
+        # emulating modern jax faithfully means disabling it.
+        del check_vma
+        kw.setdefault("check_rep", False)
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+
+    jax.shard_map = shard_map
+
+
+if not hasattr(jax.lax, "pcast"):  # jax < 0.6: no VMA type system
+    def _pcast(x, _axes=None, *, to=None):
+        # pcast only annotates varying-manual-axes types; without the VMA
+        # system there is nothing to annotate — numerically it is identity
+        del to
+        return x
+
+    jax.lax.pcast = _pcast
+
+
+def _has_enable_x64() -> bool:
+    try:  # old jax raises through its deprecation __getattr__
+        return hasattr(jax, "enable_x64")
+    except Exception:
+        return False
+
+
+if not _has_enable_x64():  # jax < 0.5: experimental spelling
+    from jax.experimental import enable_x64 as _enable_x64
+    jax.enable_x64 = _enable_x64

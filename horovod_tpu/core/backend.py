@@ -117,20 +117,49 @@ class Backend:
                 # re-init), not a process abort. Recoverable mode stops the
                 # coordination client from fatally terminating the process
                 # on peer failure and makes shutdown() non-blocking when
-                # peers are already gone.
-                jax.config.update("jax_enable_recoverability", True)
+                # peers are already gone. (Older jax has no recoverable
+                # mode — elastic still works, but peer crashes there can
+                # kill survivors hard instead of raising.)
+                try:
+                    jax.config.update("jax_enable_recoverability", True)
+                except (AttributeError, ValueError) as e:
+                    import logging
+                    logging.getLogger("horovod_tpu").warning(
+                        "jax_enable_recoverability unavailable on this jax "
+                        "(%s); elastic peer-crash recovery degraded", e)
             heartbeat = int(os.environ.get(
                 env_mod.HOROVOD_TPU_HEARTBEAT_TIMEOUT,
                 "10" if elastic else "100"))
             shutdown_t = int(os.environ.get(
                 env_mod.HOROVOD_TPU_SHUTDOWN_TIMEOUT,
                 "30" if elastic else "300"))
-            jax.distributed.initialize(coordinator_address=coord,
-                                       num_processes=int(nprocs),
-                                       process_id=proc_id,
-                                       coordinator_bind_address=bind,
-                                       heartbeat_timeout_seconds=heartbeat,
-                                       shutdown_timeout_seconds=shutdown_t)
+            try:
+                # Older jax ships CPU cross-process collectives behind this
+                # knob (modern jax enables gloo automatically); without it
+                # every multiprocess CPU collective fails at dispatch.
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:
+                pass  # knob absent (modern jax): gloo is the default there
+            kwargs = dict(coordinator_address=coord,
+                          num_processes=int(nprocs),
+                          process_id=proc_id,
+                          coordinator_bind_address=bind,
+                          heartbeat_timeout_seconds=heartbeat,
+                          shutdown_timeout_seconds=shutdown_t)
+            # Older jax exposes fewer knobs on initialize(); passing an
+            # unknown kwarg would kill every worker at startup, so filter
+            # by the live signature (defaults then apply).
+            try:
+                import inspect
+                sig = inspect.signature(jax.distributed.initialize)
+                if not any(p.kind == p.VAR_KEYWORD
+                           for p in sig.parameters.values()):
+                    kwargs = {k: v for k, v in kwargs.items()
+                              if k in sig.parameters}
+            except (TypeError, ValueError):
+                pass
+            jax.distributed.initialize(**kwargs)
             self._distributed = True
         self._rank = jax.process_index()
         self._size = jax.process_count()
@@ -384,6 +413,22 @@ class Backend:
         global_shape = (self._size,) + tuple(shard.shape[1:])
         return jax.make_array_from_single_device_arrays(
             global_shape, self._group_sharding, [shard])
+
+    def world_view(self, local_value) -> jax.Array:
+        """Present this process's tensor as a 'replicated' global array over
+        the group mesh with NO device dispatch: the array keeps its natural
+        shape (no ``x[None]`` reshape launch) and each process contributes
+        its own — genuinely different — shard. Only sound as input to a
+        ``shard_map`` with ``in_specs=P()``, where the manual region sees
+        each rank's own value (the step-replay program's zero-dispatch
+        lift); consuming it as a true replicated value would read one
+        rank's data as everyone's."""
+        import jax.numpy as jnp
+        x = jnp.asarray(local_value)
+        local_dev = self._group_mesh.devices.flat[self._rank]
+        shard = jax.device_put(x, local_dev)  # no-op when already resident
+        return jax.make_array_from_single_device_arrays(
+            tuple(x.shape), self._rep_sharding, [shard])
 
     def from_global(self, garr: jax.Array):
         """Extract this process's slice of a stacked (size, *s) result."""

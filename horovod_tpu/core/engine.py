@@ -22,6 +22,7 @@ makes cross-rank readiness implicit. What remains, and lives here:
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
@@ -253,8 +254,7 @@ _JOIN_META_LEN = 3 + _JOIN_META_DIMS  # [op_or_root, dtype, ndim, d0..d6]
 # sides derive deterministically from the head). 16 slots keep the head at
 # ~1.3 KB — single-tensor ops dominate, and large grouped calls pay one
 # extra (still async) overflow dispatch.
-_JOIN_META_SLOTS = int(__import__("os").environ.get(
-    "HOROVOD_JOIN_META_SLOTS", "16"))
+_JOIN_META_SLOTS = int(os.environ.get("HOROVOD_JOIN_META_SLOTS", "16"))
 _JOIN_HEAD_LEN = 4 + _JOIN_META_SLOTS * _JOIN_META_LEN
 
 
@@ -301,6 +301,24 @@ class Engine:
         # HOROVOD_AUTOTUNE=1; scores throughput per drain-cycle and retunes
         # fusion_threshold / cycle_time
         self.parameter_manager = None
+        self._pm_marked_token = -1
+        # engine-issued XLA program launches (collectives, packs, metadata
+        # exchanges, replay steps); the bench's dispatch-count attribution
+        # of the eager-vs-SPMD gap reads deltas of this
+        self.dispatch_count = 0
+        # elastic world identity: an elastic reset re-inits with a bumped
+        # HOROVOD_TPU_WORLD_VERSION; the step-replay subsystem invalidates
+        # every armed stream when this moves
+        self.world_version = int(
+            os.environ.get("HOROVOD_TPU_WORLD_VERSION", "0") or 0)
+        # step-capture replay (core/replay.py): records the dispatch stream
+        # between step_begin/step_end and re-executes steady-state steps as
+        # one fused launch
+        from .replay import StepReplay
+        self._replay = StepReplay(self)
+        # replay observability hooks, wired by GlobalState
+        self.on_replay: Optional[Callable[[str, str], None]] = None
+        self.replay_fallback_counter: Optional[Callable[[str], None]] = None
         self._hier_ok: Optional[bool] = None
         # One-shot flag: the next engine-method call is a Join zero-tensor
         # substitute — it must skip its own join round (the join() loop
@@ -373,6 +391,74 @@ class Engine:
         with self._lock:
             self._outstanding[name] = h
 
+    # -- step-capture replay (core/replay.py) ------------------------------
+
+    def step_begin(self):
+        """Mark the start of one eager training step. Between step_begin and
+        step_end the engine records the ordered dispatch stream; once the
+        same signature repeats ``step_replay_warmup`` times, matching steps
+        are serviced by a single fused XLA launch (see core/replay.py)."""
+        self._replay.step_begin()
+
+    def step_end(self):
+        self._replay.step_end()
+
+    def _refresh_world_version(self) -> int:
+        """Pick up an elastic world-version bump. A reset normally rebuilds
+        the Engine (backend.shutdown + init), but the rendezvous records the
+        new version in HOROVOD_TPU_WORLD_VERSION — re-reading it here keeps
+        the replay invalidation guard live even for an engine object that
+        survives a re-rendezvous. The attribute only moves forward (tests
+        may bump it directly)."""
+        v = os.environ.get("HOROVOD_TPU_WORLD_VERSION")
+        if v:
+            try:
+                ev = int(v)
+            except ValueError:
+                return self.world_version
+            if ev > self.world_version:
+                self.world_version = ev
+        return self.world_version
+
+    @property
+    def replay(self):
+        return self._replay
+
+    def _emit_replay(self, event: str, detail: str):
+        if self.on_replay is not None:
+            self.on_replay(event, detail)
+
+    def _pm_step(self, nbytes: int):
+        """Autotune step boundary + live knob application (the block the
+        grouped-allreduce path used to inline). Guarded by the replay step
+        token so a step serviced partly by replay and partly by the normal
+        path marks exactly once; outside step markers every grouped call
+        marks, the legacy cadence."""
+        pm = self.parameter_manager
+        if pm is None:
+            return
+        tok = self._replay.pm_token()
+        if tok is not None:
+            if tok == self._pm_marked_token:
+                return
+            self._pm_marked_token = tok
+        if pm.active:
+            # program-ordered autotune step boundary: score the previous
+            # step, possibly retune knobs (collective sync inside is safe
+            # here — every rank hits this call in the same order)
+            pm.step_mark(nbytes)
+        # knob values apply while tuning AND after convergence (the winner
+        # must stick, controller.cc:34-48 SynchronizeParameters)
+        self.config.fusion_threshold_bytes = pm.fusion_threshold_bytes
+        self.config.cycle_time_ms = pm.cycle_time_ms
+        # categorical knobs (parameter_manager.h:225-228): hierarchy /
+        # Pallas-pack / replay choices flip between samples, synchronized
+        # across ranks by the pm's rank-0 broadcast at sample boundaries
+        for knob in ("hierarchical_allreduce", "hierarchical_allgather",
+                     "single_launch", "step_replay"):
+            if pm.tunes(knob):
+                setattr(self.config, knob, pm.categorical_value(knob))
+
     def _dispatch(self, names, fn, *args):
         """Dispatch with failure translation + a timeline ACTIVITY span per
         involved tensor (QUEUE/MEMCPY/NCCL_* span analog, common.h:32-62;
@@ -386,6 +472,7 @@ class Engine:
         self._last_builder_fresh = False
         if isinstance(names, str):
             names = [names]
+        self.dispatch_count += 1
         t0 = time.perf_counter()
         try:
             return _translate_failure(fn, *args)
@@ -447,6 +534,10 @@ class Engine:
         """This rank is out of data: keep matching peers' collectives with
         zero tensors until every rank joins; returns the last joining rank
         (reference join semantics, operations.cc:1004-1040)."""
+        # The world is entering a ragged-batch phase: every armed replay
+        # stream is invalid until steady state re-establishes itself
+        # (ISSUE r5 tentpole: replay must fall back while join is active).
+        self._replay.invalidate_all("join() entered")
         size = self.backend.size()
         if size <= 1:
             return 0
@@ -708,6 +799,11 @@ class Engine:
         x = jnp.asarray(tensor)
         sub = self._consume_substitute()
         _check_average_dtype(x, op)
+        r = self._replay.intercept("allreduce", [x], int(op),
+                                   prescale_factor, postscale_factor, name,
+                                   sub)
+        if r is not None:
+            return r[0]
         name = self._register(name, "allreduce", x.nbytes)
         self._join_sync("allreduce", [_join_meta_row(x, int(op))], skip=sub)
         self._debug_check(name, "allreduce", [x], op_code=int(op),
@@ -727,32 +823,16 @@ class Engine:
         sub = self._consume_substitute()
         for t in tensors:
             _check_average_dtype(t, op)
+        if tensors:
+            r = self._replay.intercept("grouped_allreduce", tensors, int(op),
+                                       prescale_factor, postscale_factor,
+                                       name, sub)
+            if r is not None:
+                return r
         self._join_sync("grouped_allreduce",
                         [_join_meta_row(t, int(op)) for t in tensors],
                         skip=sub)
-        pm = self.parameter_manager
-        if pm is not None:
-            if pm.active:
-                # program-ordered autotune step boundary: score the previous
-                # step, possibly retune knobs (collective sync inside is
-                # safe here — every rank hits this call in the same order)
-                pm.step_mark(sum(t.nbytes for t in tensors))
-            # knob values apply while tuning AND after convergence (the
-            # winner must stick, controller.cc:34-48 SynchronizeParameters)
-            self.config.fusion_threshold_bytes = pm.fusion_threshold_bytes
-            self.config.cycle_time_ms = pm.cycle_time_ms
-            # categorical knobs (parameter_manager.h:225-228): hierarchy /
-            # Pallas-pack choices flip between samples, synchronized across
-            # ranks by the pm's rank-0 broadcast at sample boundaries
-            if pm.tunes("hierarchical_allreduce"):
-                self.config.hierarchical_allreduce = \
-                    pm.categorical_value("hierarchical_allreduce")
-            if pm.tunes("hierarchical_allgather"):
-                self.config.hierarchical_allgather = \
-                    pm.categorical_value("hierarchical_allgather")
-            if pm.tunes("single_launch"):
-                self.config.single_launch = \
-                    pm.categorical_value("single_launch")
+        self._pm_step(sum(t.nbytes for t in tensors))
         names = [self._register(None if name is None else f"{name}.{i}",
                                 "grouped_allreduce", t.nbytes)
                  for i, t in enumerate(tensors)]
@@ -766,6 +846,7 @@ class Engine:
                       if (self.config.hierarchical_allreduce and
                           self._hierarchical_ok()) else 0)
         from ..ops.pallas_kernels import pack_pallas, pack_pallas_enabled
+        pm = self.parameter_manager
         use_pallas_pack = (pm.categorical_value("pallas_pack")
                            if pm is not None and pm.tunes("pallas_pack")
                            else pack_pallas_enabled())
@@ -785,6 +866,7 @@ class Engine:
             pack_fn = self._builder(
                 ("pack_group", shapes, dtypes, bkey),
                 lambda: C.build_pack_group(buckets))
+            self.dispatch_count += 1
             packed = _translate_failure(pack_fn, *tensors)
             fn = self._builder(
                 ("grouped_allreduce", op, prescale_factor,
@@ -808,6 +890,7 @@ class Engine:
                 bucket = [tensors[i] for i in idxs]
                 shapes = tuple(tuple(t.shape) for t in bucket)
                 dtype = bucket[0].dtype
+                self.dispatch_count += 1
                 if use_pallas_pack:
                     packed = _translate_failure(pack_pallas, bucket)
                 else:
@@ -857,6 +940,7 @@ class Engine:
         hot peers' deferred check still sees an unchanged world)."""
         x = jnp.asarray(tensor)
         sub = self._consume_substitute()
+        self._replay.observe("allgather", sub, [x], name)
         name = self._register(name, "allgather", x.nbytes)
         key_hash = _sub_hash if _sub_hash is not None else \
             self._meta_hash(name)
@@ -926,6 +1010,10 @@ class Engine:
     def broadcast(self, tensor, root_rank: int, name: Optional[str] = None) -> Handle:
         x = jnp.asarray(tensor)
         sub = self._consume_substitute()
+        r = self._replay.intercept("broadcast", [x], root_rank, 1.0, 1.0,
+                                   name, sub)
+        if r is not None:
+            return r[0]
         name = self._register(name, "broadcast", x.nbytes)
         self._join_sync("broadcast", [_join_meta_row(x, root_rank)], skip=sub)
         self._debug_check(name, "broadcast", [x], op_code=root_rank,
@@ -975,6 +1063,10 @@ class Engine:
         sub = self._consume_substitute()
         if not tensors:
             return []
+        r = self._replay.intercept("grouped_broadcast", tensors, root_rank,
+                                   1.0, 1.0, name, sub)
+        if r is not None:
+            return r
         self._join_sync("grouped_broadcast",
                         [_join_meta_row(t, root_rank) for t in tensors],
                         skip=sub)
@@ -1038,6 +1130,7 @@ class Engine:
         :meth:`allgather` — the join-substitute replay path."""
         x = jnp.asarray(tensor)
         sub = self._consume_substitute()
+        self._replay.observe("alltoall", sub, [x], name)
         name = self._register(name, "alltoall", x.nbytes)
         key_hash = _sub_hash if _sub_hash is not None else \
             self._meta_hash(name)
@@ -1107,6 +1200,7 @@ class Engine:
         x = jnp.asarray(tensor)
         sub = self._consume_substitute()
         _check_average_dtype(x, op)
+        self._replay.observe("reducescatter", sub, [x], name)
         name = self._register(name, "reducescatter", x.nbytes)
         self._join_sync("reducescatter", [_join_meta_row(x, int(op))],
                         skip=sub)
@@ -1123,9 +1217,11 @@ class Engine:
 
     def barrier(self):
         sub = self._consume_substitute()
+        self._replay.observe("barrier", sub)
         self._join_sync("barrier", [], skip=sub)
         mesh = self.backend.group_mesh
         fn = self._builder(("barrier",), lambda: C.build_barrier(mesh, self._axis()))
+        self.dispatch_count += 1
         out = _translate_failure(
             lambda: fn(self.backend.to_global(jnp.zeros((), jnp.int32))))
         _translate_failure(out.block_until_ready)
@@ -1139,6 +1235,7 @@ class Engine:
         mesh = self.backend.group_mesh
         fn = self._builder(("allgather",),
                            lambda: C.build_allgather(mesh, self._axis()))
+        self.dispatch_count += 1
         return _translate_failure(
             lambda: fn(self.backend.to_global(jnp.asarray(local_vec))))
 

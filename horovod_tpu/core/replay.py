@@ -1,0 +1,500 @@
+"""Step-capture replay: record the eager dispatch stream, re-execute it as
+one fused XLA launch.
+
+The reference's core runtime exists to amortize per-op dispatch cost
+(tensor fusion, controller.cc:652-773, + the ResponseCache,
+response_cache.h:45-102). Our eager path still paid that cost per step:
+even the single-launch grouped allreduce is pack-dispatch + reduce-dispatch
+plus per-leaf Python bookkeeping (registration, join advertisement,
+bucketing, handle tracking). This module is the CUDA-graph-style answer:
+
+- The engine exposes ``step_begin()``/``step_end()`` markers (surfaced as
+  ``hvd.step_begin``/``hvd.step_end``/``hvd.step()``; the eager optimizer
+  wraps its reduction phase in them automatically).
+- Between markers the engine reports every collective call here as a
+  :class:`CallSig` — (kind, op/root, dtypes, shapes, scale factors,
+  digit-normalized name). The ordered tuple of sigs is the step's
+  **signature**.
+- Once the same signature repeats ``HOROVOD_TPU_STEP_REPLAY_WARMUP``
+  times, the stream is **armed**: one jitted program
+  (``ops.collectives.build_replay_step``) covering every recorded call —
+  pack, per-bucket collective, unpack — is compiled, and subsequent
+  matching steps are serviced by a SINGLE dispatch (plus one
+  fire-and-forget join advertisement when the Join protocol is live).
+- Any divergence — a different op, a wait before the stream completes, a
+  substitute dispatch, extra ops after the recorded stream — falls back
+  transparently: tensors buffered so far are flushed through the recorded
+  program (missing slots zero-padded; slot outputs are independent, so the
+  prefix results are exact), the step finishes on the normal path, and a
+  timeline event + stall-inspector-visible counter record the fallback.
+- ``join()`` and an elastic world-version bump invalidate every armed
+  stream (the program may no longer match the world).
+
+Multiple distinct step signatures (e.g. alternating train/eval) each get
+their own armed program; prefix-ambiguous candidates are disambiguated by
+the next op or at ``step_end``.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import Dict, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..common.lru import lru_get, lru_put
+
+# step counters in tensor names ("grad.s17", "bench.grad.42") must not make
+# otherwise-identical steps look distinct — normalize digit runs away
+_DIGITS = re.compile(r"\d+")
+
+_REDUCE_KINDS = ("allreduce", "grouped_allreduce")
+_BCAST_KINDS = ("broadcast", "grouped_broadcast")
+_MAX_STREAMS = 16  # bound the per-signature table (LRU)
+
+
+class CallSig(NamedTuple):
+    """One recorded engine call: the replay key the ISSUE names —
+    (kind, op, dtype, shape, name) — plus the scale factors that change the
+    compiled program."""
+    kind: str
+    code: int          # ReduceOp code, or root rank for broadcasts
+    shapes: tuple      # per-tensor shape tuples
+    dtypes: tuple      # per-tensor dtype strings
+    pre: float
+    post: float
+    name: str          # digit-normalized name template
+    replayable: bool
+
+
+def _make_sig(kind: str, tensors, code: int, pre: float, post: float,
+              name: Optional[str], replayable: bool) -> CallSig:
+    return CallSig(
+        kind, int(code),
+        tuple(tuple(int(d) for d in t.shape) for t in tensors),
+        tuple(str(t.dtype) for t in tensors),
+        float(pre), float(post),
+        _DIGITS.sub("#", name or ""), replayable)
+
+
+class _LeafProxy:
+    """Shape/dtype stand-in with the ``.nbytes``/``.dtype`` surface
+    ``bucket_by_size`` consumes, so arming can bucket without tensors."""
+    __slots__ = ("shape", "dtype", "nbytes")
+
+    def __init__(self, shape, dtype_str):
+        self.shape = shape
+        self.dtype = np.dtype(dtype_str)  # ml_dtypes registers bfloat16
+        self.nbytes = int(np.prod(shape)) * self.dtype.itemsize \
+            if shape else self.dtype.itemsize
+
+
+class _Bound:
+    """Live result of one replayed tensor: the thin post-launch handle
+    surface (poll/result/synchronize), completion shared through the
+    launch's :class:`~.engine.LaunchGroup` — one readiness RPC per replayed
+    step, not per tensor."""
+    __slots__ = ("_garr", "_group", "_engine", "_val", "_have")
+
+    def __init__(self, garr, group, engine):
+        self._garr = garr
+        self._group = group
+        self._engine = engine
+        self._val = None
+        self._have = False
+
+    def poll(self) -> bool:
+        return self._group.ready()
+
+    def result(self):
+        if not self._have:
+            self._val = self._engine.backend.from_replicated(self._garr)
+            self._have = True
+        return self._val
+
+    def synchronize(self):
+        if not self._group.ready():
+            self._engine.host_blocks += 1
+            self._group.wait()
+        return self.result()
+
+
+class ReplayHandle:
+    """Handle returned while a step is being replayed. Until the recorded
+    stream completes, the fused launch has not happened yet — any wait or
+    result access forces it (zero-padding slots not yet submitted, an
+    observable fallback)."""
+    __slots__ = ("_replay", "name", "recv_sizes", "_bound")
+
+    def __init__(self, replay: "StepReplay", name: str):
+        self._replay = replay
+        self.name = name
+        self.recv_sizes = None
+        self._bound: Optional[_Bound] = None
+
+    def _require(self) -> _Bound:
+        if self._bound is None:
+            self._replay.force_launch()
+        return self._bound
+
+    def poll(self) -> bool:
+        return self._require().poll()
+
+    def result(self):
+        return self._require().result()
+
+    def synchronize(self):
+        return self._require().synchronize()
+
+
+class _Armed(NamedTuple):
+    stream: tuple                 # tuple[CallSig]
+    segments: tuple               # build_replay_step segment specs
+    builder_key: tuple
+    nbytes: int
+    threshold: int
+    hier_local: int
+    join_metas: Optional[list]    # np rows for the one-step advertisement
+
+
+class StepReplay:
+    """Per-engine capture/replay state machine. All mutation happens on the
+    dispatching (user) thread; the cycle thread only polls the tracked
+    representative handle."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        # signature -> {"streak": int, "armed": _Armed|None}
+        self._seen: Dict[tuple, dict] = {}
+        self._mode = "idle"   # idle|off|record|replay|drain
+        self._in_step = False
+        self._step_token = 0
+        self._world_version = engine.world_version
+        self._recording: List[CallSig] = []
+        # replay-mode per-step state
+        self._cands: List[tuple] = []
+        self._pos = 0
+        self._buffered: List[list] = []
+        self._handles: List[List[ReplayHandle]] = []
+        self._launched = False
+        # observability counters (bench + stall inspector read these)
+        self.replayed_steps = 0
+        self.captured_streams = 0
+        self.fallbacks = 0
+
+    # -- step lifecycle ----------------------------------------------------
+
+    def pm_token(self) -> Optional[int]:
+        """Autotune step identity: one ``step_mark`` per marked step (None
+        outside markers preserves the per-grouped-call legacy cadence)."""
+        return self._step_token if self._in_step else None
+
+    def step_begin(self):
+        if self._in_step:
+            self.step_end()
+        eng = self.engine
+        self._step_token += 1
+        self._in_step = True
+        self._recording = []
+        self._pos = 0
+        self._buffered = []
+        self._handles = []
+        self._launched = False
+        version = eng._refresh_world_version()
+        if version != self._world_version:
+            self.invalidate_all("world-version bump "
+                                f"({self._world_version} -> {version})")
+            self._world_version = version
+        if not eng.config.step_replay:
+            self._mode = "off"
+            return
+        cands = [s for s, ent in self._seen.items()
+                 if self._current_armed(s, ent) is not None]
+        if cands:
+            self._mode = "replay"
+            self._cands = cands
+        else:
+            self._mode = "record"
+
+    def step_end(self):
+        if not self._in_step:
+            return
+        try:
+            if self._mode == "replay" and self._pos > 0 and not self._launched:
+                complete = [s for s in self._cands if len(s) == self._pos]
+                if complete:
+                    # prefix-ambiguity resolved by the step ending here
+                    self._launch(complete[0])
+                else:
+                    self._fallback("step ended before the recorded stream "
+                                   "completed")
+            stream = tuple(self._recording)
+            if stream:
+                self._note_stream(stream)
+        finally:
+            self._mode = "idle"
+            self._in_step = False
+            self._cands = []
+
+    def _note_stream(self, stream: tuple):
+        ent = lru_get(self._seen, stream)
+        if ent is None:
+            ent = lru_put(self._seen, stream, {"streak": 0, "armed": None},
+                          _MAX_STREAMS)
+        ent["streak"] += 1
+        cfg = self.engine.config
+        if (ent["armed"] is None and cfg.step_replay
+                and not cfg.debug_consistency
+                and ent["streak"] >= max(cfg.step_replay_warmup, 1)):
+            ent["armed"] = self._build_armed(stream)
+            if ent["armed"] is not None:
+                self.captured_streams += 1
+                self.engine._emit_replay(
+                    "capture",
+                    f"armed after {ent['streak']} identical steps: "
+                    f"{len(stream)} ops, "
+                    f"{sum(len(s.shapes) for s in stream)} tensors")
+
+    def invalidate_all(self, reason: str):
+        """Drop every armed stream and recorded streak (join(), elastic
+        world-version bumps, explicit resets)."""
+        had_armed = any(e.get("armed") for e in self._seen.values())
+        self._seen.clear()
+        if self._mode in ("replay", "drain"):
+            if self._pos > 0 and not self._launched:
+                self._fallback(f"invalidated mid-step: {reason}")
+            self._mode = "record" if self._in_step else "idle"
+            self._cands = []
+        if had_armed:
+            self.engine._emit_replay("invalidate", reason)
+
+    # -- per-call interception --------------------------------------------
+
+    def intercept(self, kind: str, tensors: Sequence, code: int, pre: float,
+                  post: float, name: Optional[str], sub: bool):
+        """Called by every engine collective entry point. Returns None to
+        proceed on the normal path, or the list of handles servicing the
+        call from the (pending) fused launch."""
+        mode = self._mode
+        if mode in ("idle", "off"):
+            return None
+        if sub:
+            # a Join zero-substitute mid-step: never replay it, and a step
+            # containing one is not steady state
+            if mode in ("replay", "drain"):
+                self._fallback("join substitute dispatched mid-step")
+            self._recording.append(_make_sig(kind, tensors, code, pre, post,
+                                             name, replayable=False))
+            return None
+        sig = _make_sig(kind, tensors, code, pre, post, name,
+                        replayable=kind in _REDUCE_KINDS + _BCAST_KINDS)
+        self._recording.append(sig)
+        if mode == "record":
+            return None
+        if mode == "drain":
+            # more ops than the replayed stream had: the prefix was already
+            # serviced correctly; finish the step on the normal path and let
+            # the longer signature be learned from _recording
+            self._fallback("ops submitted after the replayed stream "
+                           "completed")
+            return None
+        # mode == "replay"
+        if kind == "grouped_allreduce":
+            # program-ordered autotune boundary (the normal grouped path's
+            # step_mark); may reenter the engine (parameter broadcast) and
+            # knock us out of replay — re-check after
+            self.engine._pm_step(sum(t.nbytes for t in tensors))
+            if self._mode != "replay":
+                return None
+        cands = [s for s in self._cands
+                 if len(s) > self._pos and s[self._pos] == sig]
+        if not cands:
+            self._fallback(f"signature divergence at op {self._pos} "
+                           f"({kind})")
+            return None
+        self._cands = cands
+        handles = [ReplayHandle(self, f"{name or kind}.{j}")
+                   for j in range(len(tensors))]
+        self._buffered.append(list(tensors))
+        self._handles.append(handles)
+        self._pos += 1
+        complete = [s for s in cands if len(s) == self._pos]
+        if complete and len(cands) == 1:
+            self._launch(complete[0])
+            self._mode = "drain"
+        return handles
+
+    def observe(self, kind: str, sub: bool, tensors: Sequence = (),
+                name: Optional[str] = None):
+        """Record (or fall back on) an engine call replay cannot service —
+        allgather/alltoall/reducescatter/barrier/adasum. A step containing
+        one never arms; encountering one while replaying is a divergence."""
+        mode = self._mode
+        if mode in ("idle", "off"):
+            return
+        if mode in ("replay", "drain"):
+            self._fallback(f"unreplayable op {kind} mid-step")
+        self._recording.append(_make_sig(kind, tensors, 0, 1.0, 1.0, name,
+                                         replayable=False))
+
+    def force_launch(self):
+        """A ReplayHandle was awaited before the recorded stream completed:
+        dispatch now. A candidate complete at this position launches clean;
+        otherwise zero-pad (observable fallback)."""
+        if self._launched:
+            return
+        complete = [s for s in self._cands if len(s) == self._pos]
+        if complete:
+            self._launch(complete[0])
+            self._mode = "drain"
+        else:
+            self._fallback("handle awaited before the recorded stream "
+                           "completed")
+
+    # -- internals ---------------------------------------------------------
+
+    def _current_armed(self, stream: tuple, ent: dict) -> Optional[_Armed]:
+        """The armed program, re-derived if a tuned knob (fusion threshold,
+        hierarchy) moved since it was built."""
+        armed = ent.get("armed")
+        if armed is None:
+            return None
+        cfg = self.engine.config
+        hier = self._hier_local()
+        if (armed.threshold != cfg.fusion_threshold_bytes
+                or armed.hier_local != hier):
+            armed = self._build_armed(stream)
+            ent["armed"] = armed
+        return armed
+
+    def _hier_local(self) -> int:
+        eng = self.engine
+        if eng.config.hierarchical_allreduce and eng._hierarchical_ok():
+            return eng.backend.local_size()
+        return 0
+
+    def _build_armed(self, stream: tuple) -> Optional[_Armed]:
+        eng = self.engine
+        cfg = eng.config
+        if not all(sig.replayable for sig in stream):
+            return None
+        join_live = cfg.join_enabled and eng.backend.size() > 1
+        # segments: consecutive calls sharing (class, code, scales) fuse
+        from .engine import bucket_by_size, _DTYPE_CODES, _JOIN_META_DIMS
+        segs: List[dict] = []
+        for sig in stream:
+            cls = "reduce" if sig.kind in _REDUCE_KINDS else "bcast"
+            key = (cls, sig.code, sig.pre, sig.post)
+            if not segs or segs[-1]["key"] != key:
+                segs.append({"key": key, "shapes": [], "dtypes": []})
+            segs[-1]["shapes"].extend(sig.shapes)
+            segs[-1]["dtypes"].extend(sig.dtypes)
+        join_metas = None
+        if join_live:
+            # Joined peers match the advertisement with a grouped_allreduce
+            # zero substitute, whose wire sequence is the per-bucket reduce
+            # collectives — identical to the replay program's ONLY for a
+            # single reduce segment. Anything else stays unarmed in Join
+            # worlds.
+            if len(segs) != 1 or segs[0]["key"][0] != "reduce":
+                return None
+            op_code = segs[0]["key"][1]
+            rows = []
+            for shape, dt in zip(segs[0]["shapes"], segs[0]["dtypes"]):
+                code = _DTYPE_CODES.get(dt)
+                if code is None or len(shape) > _JOIN_META_DIMS:
+                    return None
+                dims = list(shape) + [-1] * (_JOIN_META_DIMS - len(shape))
+                rows.append(np.array([op_code, code, len(shape)] + dims,
+                                     dtype=np.int64))
+            join_metas = rows
+        hier_local = self._hier_local()
+        built = []
+        nbytes = 0
+        for seg in segs:
+            cls, code, pre, post = seg["key"]
+            proxies = [_LeafProxy(s, d)
+                       for s, d in zip(seg["shapes"], seg["dtypes"])]
+            nbytes += sum(p.nbytes for p in proxies)
+            buckets = bucket_by_size(proxies, cfg.fusion_threshold_bytes)
+            built.append((cls, code, pre, post,
+                          hier_local if cls == "reduce" else 0,
+                          tuple(seg["shapes"]),
+                          tuple(tuple(b) for b in buckets)))
+        return _Armed(stream, tuple(built),
+                      ("replay_step", stream, cfg.fusion_threshold_bytes,
+                       hier_local),
+                      nbytes, cfg.fusion_threshold_bytes, hier_local,
+                      join_metas)
+
+    def _fallback(self, reason: str):
+        self.fallbacks += 1
+        eng = self.engine
+        if eng.replay_fallback_counter is not None:
+            eng.replay_fallback_counter(reason)
+        eng._emit_replay("fallback", reason)
+        if self._pos > 0 and not self._launched:
+            # flush the buffered prefix through the recorded program with
+            # zero-padded missing slots — every rank reaches this fallback
+            # at the same program point, so the launch still matches peers
+            # (and any joined rank's substitute); slot outputs are
+            # independent, so the prefix results are exact
+            self._launch(min(self._cands, key=len), padded=True)
+        self._mode = "record" if self._in_step else "idle"
+        self._cands = []
+
+    def _launch(self, stream: tuple, padded: bool = False):
+        from . import engine as engine_mod
+        eng = self.engine
+        ent = self._seen.get(stream)
+        armed = self._current_armed(stream, ent) if ent else None
+        if armed is None:  # knob moved to an unarmable config mid-step
+            armed = self._build_armed(stream)
+        if armed is None:
+            raise engine_mod.HorovodInternalError(
+                "replay stream lost its armed program mid-step")
+        flat = []
+        for ci, sig in enumerate(stream):
+            bufs = self._buffered[ci] if ci < len(self._buffered) else None
+            if bufs is None:
+                bufs = [jnp.zeros(s, jnp.dtype(d))
+                        for s, d in zip(sig.shapes, sig.dtypes)]
+            flat.extend(bufs)
+        if armed.join_metas is not None:
+            # one fire-and-forget advertisement for the WHOLE step (the
+            # per-op join rounds the recorded path paid, collapsed to one)
+            eng._join_sync("grouped_allreduce", armed.join_metas)
+        fn = eng._builder(armed.builder_key,
+                          lambda: engine_mod.C.build_replay_step(
+                              eng.backend.group_mesh, eng._axis(),
+                              armed.segments))
+        rep_name = f"replay.step.{self._step_token & 1023}"
+        if eng.on_enqueue is not None:
+            eng.on_enqueue(rep_name, "replay", armed.nbytes)
+        t0 = time.perf_counter()
+        outs = engine_mod._translate_failure(
+            lambda: fn(*[eng.backend.world_view(t) for t in flat]))
+        eng.dispatch_count += 1
+        if eng.on_activity is not None:
+            eng.on_activity(rep_name, "XLA_REPLAY_DISPATCH",
+                            (time.perf_counter() - t0) * 1e6)
+        group = engine_mod.LaunchGroup(outs[-1])
+        k = 0
+        for ci, sig in enumerate(stream):
+            hs = self._handles[ci] if ci < len(self._handles) else None
+            for j in range(len(sig.shapes)):
+                if hs is not None:
+                    hs[j]._bound = _Bound(outs[k], group, eng)
+                k += 1
+        # ONE tracked representative per replayed step: retires through the
+        # cycle loop, feeds the stall inspector and timeline done events
+        rep = engine_mod.Handle(rep_name, [outs[-1]], lambda gs: None, eng,
+                                group=group)
+        eng._track(rep_name, rep)
+        self._launched = True
+        if not padded:
+            self.replayed_steps += 1
+            eng._emit_replay(
+                "replay", f"{len(flat)} tensors in 1 launch ({rep_name})")

@@ -76,6 +76,10 @@ class GlobalState:
                 categorical += ["pallas_pack"]
             # one-vs-two-dispatch grouped allreduce: always expressible
             categorical += ["single_launch"]
+            # step-capture replay on/off (core/replay.py): whether fusing
+            # the whole steady-state step into one launch beats the grouped
+            # path depends on per-dispatch overhead, a per-runtime fact
+            categorical += ["step_replay"]
             self.parameter_manager = ParameterManager(
                 warmup_samples=cfg.autotune_warmup_samples,
                 steps_per_sample=cfg.autotune_steps_per_sample,
@@ -95,6 +99,7 @@ class GlobalState:
                     # doesn't silently flip an explicitly-requested kernel
                     "pallas_pack": pack_pallas_enabled(),
                     "single_launch": cfg.single_launch,
+                    "step_replay": cfg.step_replay,
                 })
             self.engine.parameter_manager = self.parameter_manager
 
@@ -118,9 +123,16 @@ class GlobalState:
             if timeline is not None:
                 timeline.record_activity(name, activity, dur_us)
 
+        def on_replay(event, detail):
+            if timeline is not None:
+                timeline.record_replay(event, detail)
+
         engine.on_enqueue = on_enqueue
         engine.on_done = on_done
         engine.on_activity = on_activity
+        engine.on_replay = on_replay
+        if stall is not None:
+            engine.replay_fallback_counter = stall.record_replay_fallback
 
     def shutdown(self):
         with self._lock:

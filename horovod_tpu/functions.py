@@ -9,6 +9,7 @@ frontends.
 
 from __future__ import annotations
 
+import contextlib
 import io
 import pickle
 from typing import Any, Optional
@@ -25,6 +26,50 @@ def _engine():
     if not st.initialized:
         raise ValueError("horovod_tpu has not been initialized; run hvd.init() first.")
     return st.engine
+
+
+def step_begin():
+    """Mark the start of one eager training step for step-capture replay
+    (core/replay.py): the engine records the ordered (kind, op, dtype,
+    shape, name) dispatch stream between ``step_begin()`` and
+    ``step_end()``; once the same signature repeats
+    ``HOROVOD_TPU_STEP_REPLAY_WARMUP`` times (default 3; master switch
+    ``HOROVOD_TPU_STEP_REPLAY``, also an autotune categorical), matching
+    steps are serviced by a SINGLE fused XLA launch, with transparent
+    zero-padded fallback on any divergence or early wait and invalidation
+    under ``join()`` and elastic world-version bumps — see
+    docs/observability.md for the fallback taxonomy and events.
+
+    ``DistributedEagerOptimizer`` wraps its reduction phase in these markers
+    automatically; hand-rolled loops that call ``allreduce_async`` per leaf
+    opt in by bracketing the step themselves (or via :func:`step`)."""
+    _engine().step_begin()
+
+
+def step_end():
+    """Close the step opened by :func:`step_begin` (records/arms/launches as
+    appropriate; safe to call with no step open)."""
+    _engine().step_end()
+
+
+@contextlib.contextmanager
+def step():
+    """Context manager bracketing one eager training step for step-capture
+    replay — the ``with hvd.step():`` form of
+    :func:`step_begin`/:func:`step_end`.
+
+    ::
+
+        with hvd.step():
+            for name, g in grads.items():
+                handles[name] = hvd.allreduce_async(g, name=name)
+    """
+    eng = _engine()
+    eng.step_begin()
+    try:
+        yield
+    finally:
+        eng.step_end()
 
 
 def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+from .. import compat as _compat  # noqa: F401  (aliases jax.shard_map)
 from jax import shard_map
 
 
@@ -161,6 +163,9 @@ def adasum_allreduce_handle(engine, tensor, name=None, prescale_factor=1.0,
     """Engine entry point for op=Adasum on the eager path."""
     x = jnp.asarray(tensor)
     sub = engine._consume_substitute()
+    # Adasum's per-tensor coefficient recursion cannot ride the packed
+    # replay program — mark the step unreplayable (core/replay.py).
+    engine._replay.observe("adasum", sub, [x], name)
     name = engine._register(name, "adasum", x.nbytes)
     from ..core.engine import _join_meta_row
     engine._join_sync("adasum", [_join_meta_row(x, 0)], skip=sub)

@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import compat as _compat  # noqa: F401  (aliases jax.shard_map)
 from jax import shard_map
 
 from ..common.reduce_ops import ReduceOp
@@ -433,6 +435,18 @@ def build_pack_group(buckets):
     return jax.jit(f)
 
 
+def _check_bucket_dtypes(dtypes, buckets):
+    """Per-bucket dtype uniformity is the ``bucket_by_size`` contract the
+    packed-buffer math relies on (a mixed-dtype concat would silently
+    promote); assert it here so a hand-rolled bucket list fails loudly."""
+    for idxs in buckets:
+        kinds = {str(dtypes[i]) for i in idxs}
+        if len(kinds) > 1:
+            raise ValueError(
+                f"fusion bucket {list(idxs)} mixes dtypes {sorted(kinds)}; "
+                f"buckets must be dtype-uniform (bucket_by_size contract)")
+
+
 def build_grouped_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
                             shapes, dtypes, buckets,
                             prescale_factor: float = 1.0,
@@ -454,6 +468,7 @@ def build_grouped_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
       buckets: list of index lists partitioning range(len(shapes)),
         same-dtype within a bucket (bucket_by_size output).
     """
+    _check_bucket_dtypes(dtypes, buckets)
     n = int(mesh.devices.size)
     _reduce_flat = _make_reduce_flat(axis, op, n, local_size)
     sizes = [math.prod(s) for s in shapes]
@@ -512,6 +527,68 @@ def build_pack(shapes, dtype):
         return jnp.concatenate([jnp.ravel(t) for t in ts]) if ts \
             else jnp.zeros((0,), dtype)
     return jax.jit(f)
+
+
+def build_replay_step(mesh: Mesh, axis: str, segments):
+    """ONE launch for a whole captured eager step (core/replay.py): every
+    recorded collective call's pack, reduction/broadcast, and unpack fused
+    into a single jitted program — the XLA answer to CUDA-graph capture of
+    the steady-state dispatch stream (the reference amortizes the same
+    per-op cost with its background fusion cycle, operations.cc:566-616;
+    here the whole cycle collapses to one dispatch).
+
+    Inputs are the step's local tensors in recorded order, presented as
+    'replicated' world-view arrays (``Backend.world_view``: each rank
+    contributes its own shard, a zero-dispatch metadata lift). With
+    ``in_specs=P()`` the manual region sees each rank's own value, so the
+    per-bucket psum/broadcast reduces genuinely distinct per-rank data —
+    this is only sound from shard_map manual code, which is why the lift
+    helper is engine-internal.
+
+    Args:
+      segments: sequence of ``(cls, code, pre, post, local_size, shapes,
+        buckets)`` tuples — ``cls`` is ``"reduce"`` (code = ReduceOp) or
+        ``"bcast"`` (code = root rank); ``shapes`` are the segment's tensor
+        shapes in order; ``buckets`` index into them (dtype-uniform, from
+        ``bucket_by_size``).
+    """
+    n = int(mesh.devices.size)
+    n_tensors = sum(len(seg[5]) for seg in segments)
+
+    def body(*ts):  # each rank's own local tensors, natural shapes
+        outs = [None] * n_tensors
+        base = 0
+        for cls, code, pre, post, local_size, shapes, buckets in segments:
+            sizes = [math.prod(s) for s in shapes]
+            if cls == "reduce":
+                reduce_flat = _make_reduce_flat(axis, ReduceOp(code), n,
+                                                local_size)
+            for idxs in buckets:
+                flat = jnp.concatenate(
+                    [jnp.ravel(ts[base + i]) for i in idxs])
+                if cls == "reduce":
+                    if pre != 1.0:
+                        flat = flat * pre
+                    red = reduce_flat(flat)
+                    if post != 1.0:
+                        red = red * post
+                else:
+                    red = broadcast_p(flat, axis, code)
+                off = 0
+                for i in idxs:
+                    outs[base + i] = lax.dynamic_slice_in_dim(
+                        red, off, sizes[i]).reshape(shapes[i])
+                    off += sizes[i]
+            base += len(shapes)
+        return tuple(outs)
+
+    # inputs are claimed-replicated world views (varying in truth) and the
+    # outputs are replicated by construction — the VMA checker can infer
+    # neither, same as the ladder builders above
+    fn = _shmap(body, mesh, axis, in_specs=tuple(P() for _ in range(n_tensors)),
+                out_specs=tuple(P() for _ in range(n_tensors)),
+                check_vma=False)
+    return jax.jit(fn)
 
 
 def build_barrier(mesh: Mesh, axis: str):

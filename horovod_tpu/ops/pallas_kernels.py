@@ -47,6 +47,14 @@ def _pad_to_grid(v: jax.Array):
     return v.reshape(rows, _LANES), n
 
 
+def _tpu_compiler_params(pltpu, **kw):
+    """pltpu.CompilerParams across the jax rename (older jax spells it
+    TPUCompilerParams)."""
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
 def _triple_kernel(a_ref, b_ref, acc_ref):
     """Grid-accumulated [dot(a,b), |a|², |b|²] in fp32 — one read of each
     operand for all three reductions (adasum.h:338-398 computes the same
@@ -232,8 +240,8 @@ def bn_stats_pallas(x2d: jax.Array):
                    pl.BlockSpec((1, c), lambda mi: (0, 0))],
         out_shape=[jax.ShapeDtypeStruct((1, c), jnp.float32),
                    jax.ShapeDtypeStruct((1, c), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
+        compiler_params=_tpu_compiler_params(
+            pltpu, dimension_semantics=("arbitrary",)),
         interpret=_interpret(),
     )(x2d)
     return (_unfold_stats(s[0], c_orig, k), _unfold_stats(q[0], c_orig, k))
@@ -281,8 +289,8 @@ def bn_bwd_stats_pallas(dy2d: jax.Array, x2d: jax.Array,
                    pl.BlockSpec((1, c), lambda mi: (0, 0))],
         out_shape=[jax.ShapeDtypeStruct((1, c), jnp.float32),
                    jax.ShapeDtypeStruct((1, c), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
+        compiler_params=_tpu_compiler_params(
+            pltpu, dimension_semantics=("arbitrary",)),
         interpret=_interpret(),
     )(mean.reshape(1, c).astype(jnp.float32),
       invstd.reshape(1, c).astype(jnp.float32), dy2d, x2d)

@@ -295,6 +295,17 @@ class DistributedEagerOptimizer:
         reference gets from per-parameter hooks, torch/optimizer.py:
         100-135)."""
         eng = self._engine()
+        # Step-capture markers (core/replay.py): the reduction phase of one
+        # update IS one step of the dispatch stream — after
+        # step_replay_warmup identical steps the engine services the whole
+        # grouped reduction as a single fused launch.
+        eng.step_begin()
+        try:
+            return self._reduce_async_inner(eng, leaves, sparse_ks)
+        finally:
+            eng.step_end()
+
+    def _reduce_async_inner(self, eng, leaves, sparse_ks):
         dense = [i for i, k in enumerate(sparse_ks) if k is None]
         compressed, dense_ctxs = [], []
         for i in dense:
@@ -546,7 +557,11 @@ class DistributedDeltaAdasumOptimizer:
 
             @jax.jit
             def fn(reduced_c, params):
-                deltas = [comp.decompress(r, c)
+                # ctx None = never compressed (the world-size-1 path applies
+                # u_leaves directly; ADVICE r5): don't route through
+                # decompress(r, None), whose cast is a no-op at best and a
+                # dtype surprise at worst
+                deltas = [r if c is None else comp.decompress(r, c)
                           for r, c in zip(reduced_c, ctxs)]
                 updates = jax.tree_util.tree_unflatten(treedef, deltas)
                 return optax.apply_updates(params, updates)

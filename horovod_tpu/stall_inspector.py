@@ -43,6 +43,11 @@ class StallInspector:
         self._lock = threading.Lock()
         self._outstanding: Dict[str, float] = {}
         self._warned: set = set()
+        # step-capture replay fallbacks (core/replay.py): a rank whose
+        # fallback count runs away while peers replay steadily is worth
+        # attributing, so the count rides the cross-rank liveness report
+        self.replay_fallbacks = 0
+        self._replay_reasons: Dict[str, int] = {}
         self._heartbeat_step = -1
         self._heartbeat_time = time.time()
         self._cross_warned: set = set()
@@ -59,6 +64,20 @@ class StallInspector:
         with self._lock:
             self._outstanding.pop(name, None)
             self._warned.discard(name)
+
+    def record_replay_fallback(self, reason: str):
+        """Count a step-replay fallback (bounded reason histogram; the
+        counter the ISSUE requires to be stall-inspector visible)."""
+        with self._lock:
+            self.replay_fallbacks += 1
+            if reason in self._replay_reasons or \
+                    len(self._replay_reasons) < 64:
+                self._replay_reasons[reason] = \
+                    self._replay_reasons.get(reason, 0) + 1
+
+    def replay_fallback_reasons(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._replay_reasons)
 
     def record_heartbeat(self, step: Optional[int] = None):
         """SPMD-path liveness signal: call around the jitted train step. A
@@ -93,7 +112,8 @@ class StallInspector:
             payload = {"ts": time.time(),
                        "outstanding": stale,
                        "hb_step": self._heartbeat_step,
-                       "hb_ts": self._heartbeat_time}
+                       "hb_ts": self._heartbeat_time,
+                       "replay_fallbacks": self.replay_fallbacks}
         try:
             put_data_into_kvstore(self.kv[0], self.kv[1], KV_SCOPE,
                                   str(self.rank),

@@ -132,6 +132,22 @@ class Timeline:
         self._q.put({"name": activity, "ph": "X", "ts": self._ts_us() - dur_us,
                      "dur": dur_us, "pid": 0, "tid": self._tid(name)})
 
+    def record_replay(self, event: str, detail: str = ""):
+        """Step-capture replay lifecycle instants (core/replay.py):
+        REPLAY_CAPTURE when a stream arms, REPLAY_REPLAY per fused-launch
+        step, REPLAY_FALLBACK / REPLAY_INVALIDATE with the reason."""
+        name = f"REPLAY_{event.upper()}"
+        if self._native is not None:
+            args = json.dumps({"detail": detail}).encode() if detail else None
+            self._native.hvd_timeline_event(
+                b"i", name.encode(), int(self._ts_us()), 0, 0, args)
+            return
+        ev = {"name": name, "ph": "i", "ts": self._ts_us(), "pid": 0,
+              "tid": 0, "s": "p"}
+        if detail:
+            ev["args"] = {"detail": detail}
+        self._q.put(ev)
+
     def mark_cycle(self):
         if not self.mark_cycles:
             return

@@ -192,6 +192,67 @@ def test_grouped_allreduce_single_launch_one_program():
         f"expected <= one all-reduce per bucket (2), got {n_ar}"
 
 
+def test_replay_step_lowers_to_single_fused_program():
+    """Step-capture replay (core/replay.py): a captured step of many
+    per-leaf allreduces is ONE compiled program — pack, one all-reduce per
+    fusion bucket, unpack — so the whole steady-state step is a single
+    dispatch (the ISSUE r5 acceptance bar)."""
+    from jax.sharding import NamedSharding
+    mesh = _world_mesh()
+    shapes = tuple((7, 3) for _ in range(20))
+    # one reduce segment, all 20 tensors in one bucket
+    segments = (("reduce", int(ReduceOp.SUM), 1.0, 1.0, 0, shapes,
+                 (tuple(range(20)),)),)
+    fn = C.build_replay_step(mesh, "world", segments)
+    rep = NamedSharding(mesh, P())
+    args = [jax.device_put(jnp.ones(s, jnp.float32), rep) for s in shapes]
+    hlo = _hlo(fn, *args)
+    n_ar = _count(r"all-reduce(?:-start)?\(", hlo)
+    assert n_ar == 1, f"expected ONE fused all-reduce, found {n_ar}"
+    # and it computes the allreduce: every output = 8x its input here
+    # (8 'ranks', each contributing the same replicated value)
+    outs = fn(*args)
+    np.testing.assert_allclose(np.asarray(outs[0]), 8.0 * np.ones((7, 3)),
+                               rtol=1e-6)
+
+
+def test_replay_step_multi_segment_bounded_collectives():
+    """A mixed captured step (two reduce segments with different ops + a
+    broadcast segment) still lowers to one program with at most one
+    collective per bucket."""
+    from jax.sharding import NamedSharding
+    mesh = _world_mesh()
+    segments = (
+        ("reduce", int(ReduceOp.SUM), 1.0, 1.0, 0,
+         ((16,), (16,)), ((0, 1),)),
+        ("reduce", int(ReduceOp.MAX), 1.0, 1.0, 0, ((8,),), ((0,),)),
+        ("bcast", 0, 1.0, 1.0, 0, ((4,),), ((0,),)),
+    )
+    fn = C.build_replay_step(mesh, "world", segments)
+    rep = NamedSharding(mesh, P())
+    args = [jax.device_put(jnp.ones(s, jnp.float32), rep)
+            for s in ((16,), (16,), (8,), (4,))]
+    hlo = _hlo(fn, *args)
+    n_coll = (_count(r"all-reduce(?:-start)?\(", hlo)
+              + _count(r"reduce-scatter", hlo))
+    # sum bucket + max bucket + broadcast's masked psum = at most 3
+    assert 1 <= n_coll <= 3, f"expected <=3 collectives, got {n_coll}"
+    outs = fn(*args)
+    np.testing.assert_allclose(np.asarray(outs[0]), 8.0 * np.ones((16,)))
+    np.testing.assert_allclose(np.asarray(outs[2]), np.ones((8,)))  # MAX
+    np.testing.assert_allclose(np.asarray(outs[3]), np.ones((4,)))  # bcast
+
+
+def test_grouped_allreduce_rejects_mixed_dtype_bucket():
+    """The dtypes parameter now enforces the bucket_by_size contract
+    (ADVICE r5): a hand-rolled mixed-dtype bucket fails loudly."""
+    mesh = _world_mesh()
+    with pytest.raises(ValueError, match="mixes dtypes"):
+        C.build_grouped_allreduce(mesh, "world", ReduceOp.SUM,
+                                  ((4,), (4,)), [jnp.float32, jnp.int32],
+                                  [[0, 1]])
+
+
 def test_grouped_allreduce_hierarchical_ladder():
     """The single-launch grouped program with local_size=4 must lower each
     bucket's reduction to the hierarchical RS/AG ladder with node-local
