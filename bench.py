@@ -296,6 +296,232 @@ def bench_transformer():
     return out
 
 
+def _run_forced_cpu(payload: str, n_devices: int, timeout: int = 600):
+    """Run a measurement payload in a forced-CPU child with an n-device
+    virtual world (the __graft_entry__ dryrun trick) and parse its last
+    JSON line. Used for the sections that need a multi-chip world this rig
+    does not have (sharded optimizer memory, pipeline bubble)."""
+    import re
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m:
+        count = max(int(m.group(1)), n_devices)
+        flags = (flags[:m.start()]
+                 + f"--xla_force_host_platform_device_count={count}"
+                 + flags[m.end():])
+    else:
+        flags = (flags
+                 + f" --xla_force_host_platform_device_count={n_devices}") \
+            .strip()
+    env["XLA_FLAGS"] = flags
+    env["HOROVOD_STALL_CHECK_DISABLE"] = "1"
+    proc = subprocess.run([sys.executable, "-c", payload], env=env,
+                          capture_output=True, text=True, timeout=timeout,
+                          cwd=os.path.dirname(os.path.abspath(__file__)))
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(
+        f"forced-CPU payload produced no JSON (rc={proc.returncode}): "
+        f"{proc.stderr.strip()[-500:]}")
+
+
+_SHARDED_MEMORY_PAYLOAD = r"""
+import json, time
+import numpy as np
+import jax, jax.numpy as jnp, optax
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd  # installs the jax compat shims first
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from horovod_tpu import optimizer as hopt
+from horovod_tpu.models.transformer import TransformerConfig, init_params, lean_lm_loss
+
+n = 8
+mesh = Mesh(np.array(jax.devices()[:n]), ("world",))
+# sized so the REPLICATED adam state is clearly visible next to the params
+# (fp32 adam = 2x param bytes); the flagship-config HBM fraction is
+# reported analytically by the parent
+cfg = TransformerConfig(vocab_size=8192, d_model=768, n_heads=12,
+                        n_layers=2, d_ff=3072, max_seq=128,
+                        dtype=jnp.float32, attention="flash")
+params = init_params(jax.random.PRNGKey(0), cfg)
+n_params = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))
+inner = optax.adam(1e-3)
+B, T = 8, cfg.max_seq
+tok = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (B, T)), jnp.int32)
+tgt = jnp.asarray(np.random.RandomState(1).randint(0, cfg.vocab_size, (B, T)), jnp.int32)
+sh = NamedSharding(mesh, P("world"))
+rep = NamedSharding(mesh, P())
+tokg, tgtg = jax.device_put(tok, sh), jax.device_put(tgt, sh)
+
+def dev0_bytes(tree):
+    dev0 = jax.devices()[0]
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        for s in leaf.addressable_shards:
+            if s.device == dev0:
+                total += int(s.data.nbytes)
+    return total
+
+def run(opt, state_specs, init_inside):
+    def step(p, s, xb, yb):
+        g = jax.grad(lean_lm_loss)(p, xb, yb, cfg)
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s
+    stepf = jax.jit(shard_map(step, mesh=mesh,
+                              in_specs=(P(), state_specs, P("world"), P("world")),
+                              out_specs=(P(), state_specs), check_vma=False))
+    p = jax.device_put(params, rep)
+    if init_inside:
+        st = jax.jit(shard_map(opt.init, mesh=mesh, in_specs=(P(),),
+                               out_specs=state_specs, check_vma=False))(p)
+    else:
+        st = jax.device_put(opt.init(params), rep)
+    state_bytes = dev0_bytes(st)
+    p, st = stepf(p, st, tokg, tgtg)   # compile + 1 step
+    jax.block_until_ready(p)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        p, st = stepf(p, st, tokg, tgtg)
+        jax.block_until_ready(p)
+        ts.append(time.perf_counter() - t0)
+    import statistics
+    return p, state_bytes, statistics.median(ts)
+
+dense = hopt.distributed(inner, axis_name="world", op=hvd.Average)
+dp, dense_bytes, dense_dt = run(dense, P(), init_inside=False)
+zer = hopt.distributed(inner, axis_name="world", op=hvd.Average,
+                       axis_size=n, shard_optimizer=True)
+zspecs = hopt.zero1_state_specs(jax.eval_shape(zer.init, params), "world")
+zp, shard_bytes, shard_dt = run(zer, zspecs, init_inside=True)
+err = max(float(jnp.max(jnp.abs(a - b)))
+          for a, b in zip(jax.tree_util.tree_leaves(dp),
+                          jax.tree_util.tree_leaves(zp)))
+print(json.dumps({
+    "world_size": n,
+    "n_params_m": round(n_params / 1e6, 2),
+    "replicated": dense_bytes,
+    "sharded": shard_bytes,
+    "reduction_pct": round(100.0 * (1 - shard_bytes / dense_bytes), 2),
+    "traj_max_err_4_steps": err,
+    "replicated_step_ms": round(dense_dt * 1e3, 2),
+    "sharded_step_ms": round(shard_dt * 1e3, 2),
+}))
+"""
+
+
+_PIPELINE_BUBBLE_PAYLOAD = r"""
+import json, time, statistics
+from functools import partial
+import numpy as np
+import jax, jax.numpy as jnp
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu.compat  # installs the jax compat shims first
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from horovod_tpu.parallel import (pipeline_train_1f1b, split_microbatches)
+
+S, M, D, BM = 4, 8, 1024, 96   # stages, microbatches, width, micro batch
+# cell compute must dwarf the schedule's fixed per-tick cost or the
+# marginal-microbatch probe below reads pure overhead
+mesh = Mesh(np.array(jax.devices()[:S]), ("pipe",))
+rng = np.random.RandomState(0)
+pparams = {"w": jnp.asarray(rng.randn(S, D, D), jnp.float32) * 0.05,
+           "b": jnp.asarray(rng.randn(S, D), jnp.float32) * 0.1}
+
+def stage(p, h):
+    return jnp.tanh(h @ p["w"] + p["b"])
+
+def lm_loss(y, tgt):
+    return jnp.mean((y - tgt) ** 2)
+
+def body(params, micro_in, micro_tgt):
+    local = {"w": params["w"][0], "b": params["b"][0]}
+    loss, gs, gf, gl = pipeline_train_1f1b(stage, local, micro_in,
+                                           micro_tgt, lm_loss, "pipe", S)
+    return loss, jax.tree_util.tree_map(lambda a: a[None], gs)
+
+pp = jax.jit(shard_map(body, mesh=mesh,
+                       in_specs=({"w": P("pipe"), "b": P("pipe")}, P(), P()),
+                       out_specs=(P(), {"w": P("pipe"), "b": P("pipe")}),
+                       check_vma=False))
+x = split_microbatches(jnp.asarray(rng.randn(M * BM, D), jnp.float32), M)
+t = split_microbatches(jnp.asarray(rng.randn(M * BM, D), jnp.float32), M)
+pg = jax.device_put(pparams, NamedSharding(mesh, P("pipe")))
+
+def timeit(fn, *args, reps=5):
+    out = fn(*args); jax.block_until_ready(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts)
+
+t_pp = timeit(pp, pg, x, t)
+
+# Marginal-microbatch cost, measured from the pipeline program itself:
+# extra microbatches extend the full-overlap steady phase, so
+# c = (t(M) - t(M/2)) / (M/2) is the per-microbatch cost WITHOUT the
+# startup/drain bubble, and ideal = M*c. (A serial one-device comparator
+# would be wrong here: the virtual CPU 'devices' share host cores, so
+# stage parallelism is not physically realizable in this measurement.)
+M2 = M // 2
+x2 = split_microbatches(jnp.asarray(rng.randn(M2 * BM, D), jnp.float32), M2)
+t2 = split_microbatches(jnp.asarray(rng.randn(M2 * BM, D), jnp.float32), M2)
+t_pp2 = timeit(pp, pg, x2, t2)
+c = max((t_pp - t_pp2) / (M - M2), 1e-9)
+ideal = M * c
+bubble_pct = max(0.0, (t_pp - ideal) / t_pp * 100.0)
+schedule_pct = (S - 1) / (S + M - 1) * 100.0
+print(json.dumps({
+    "stages": S, "microbatches": M,
+    "measured_1f1b_ms": round(t_pp * 1e3, 2),
+    "marginal_microbatch_ms": round(c * 1e3, 2),
+    "ideal_compute_ms": round(ideal * 1e3, 2),
+    "pipeline_bubble_pct": round(bubble_pct, 1),
+    "pipeline_bubble_schedule_pct": round(schedule_pct, 1),
+}))
+"""
+
+
+def bench_sharded_memory():
+    """ZeRO-1 acceptance numbers on a real (virtual, 8-device) multi-chip
+    world: per-chip optimizer-state bytes sharded vs replicated (measured
+    from the live arrays' addressable shards, not schedule math), the
+    sharded-vs-dense trajectory error, and step times. The flagship-config
+    HBM fraction is analytic (running the flagship replicated x8 would not
+    fit the CPU host)."""
+    out = _run_forced_cpu(_SHARDED_MEMORY_PAYLOAD, 8)
+    # flagship LM (the bench_transformer config): fp32 adam state = 2 flat
+    # copies of the params; the fraction of a v5e chip's 16 GB HBM that a
+    # REPLICATED optimizer state pins, which sharding divides by the world
+    flag_params = 268.5e6
+    flag_state_bytes = 2 * flag_params * 4
+    out["flagship_replicated_state_gb"] = round(flag_state_bytes / 2**30, 2)
+    out["flagship_replicated_state_hbm_pct_v5e"] = round(
+        flag_state_bytes / (16 * 2**30) * 100.0, 1)
+    return out
+
+
+def bench_pipeline_bubble():
+    """Measured 1F1B pipeline bubble on a 4-stage CPU-mesh pipeline
+    (VERDICT r5 gap: the overlap story was schedule math): measured step
+    time vs the measured marginal-microbatch ideal (extra microbatches
+    extend only the full-overlap steady phase, so M x marginal is the
+    bubble-free step time), with the 1F1B schedule prediction
+    (S-1)/(S+M-1) alongside for comparison."""
+    return _run_forced_cpu(_PIPELINE_BUBBLE_PAYLOAD, 4)
+
+
 def bench_sp_ring():
     """Sequence-parallel ring attention MFU at T=8192, three readings:
 
@@ -373,7 +599,19 @@ def bench_sp_ring():
         d24 = time.perf_counter() - t0
         est = max((d24 - d4) / 20.0, 1e-4)
         span = min(max(40, int(round(0.6 / est / 20.0)) * 20), 400)
-        return _marginal_median(run, st0, 4, 4 + span, reps=5)
+        med, spread, n_used = _marginal_median(run, st0, 4, 4 + span,
+                                               reps=5)
+        # Escalation (ISSUE 2 satellite: driver-run sp_ring spread hit
+        # 24.8% while the LM sections sat at ~1%): a high spread means the
+        # probe under-estimated the per-step cost and the span still sat
+        # at the noise floor — double it (same 20-step quantization, same
+        # cap) and keep the quieter reading.
+        if spread > 10.0 and span < 400:
+            med2, spread2, n2 = _marginal_median(
+                run, st0, 4, 4 + min(span * 2, 400), reps=5)
+            if spread2 < spread:
+                return med2, spread2, n2
+        return med, spread, n_used
 
     out = {}
     dt, spread, n_used = measure(
@@ -593,6 +831,56 @@ def main():
         "fallbacks": eng.replay.fallbacks,
     }
 
+    # ---- eager ZeRO-1 sharded-optimizer path ------------------------------
+    # Same measured loop, but the sync is reduce-scatter -> shard-local
+    # update -> fused allgather through engine.sharded_step (auto-bracketed
+    # by the replay markers, so steady state is ONE dispatch/step). At
+    # n_chips=1 the collective legs are identity; the number measures the
+    # sharded code path's framework cost next to eager_img_s_per_chip.
+    from horovod_tpu.optimizer import DistributedEagerOptimizer
+    try:
+        zero_opt = DistributedEagerOptimizer(
+            optax.sgd(0.01, momentum=0.9), sharded=True,
+            op=hvd.Average if hvd.size() > 1 else hvd.Sum)
+        zero_state = zero_opt.init(params)
+
+        def eager_sharded_step(params, batch_stats, opt_state, images,
+                               labels):
+            (loss, new_bs), grads = grad_fn(params, batch_stats, images,
+                                            labels)
+            params, opt_state = zero_opt.update_and_apply(grads, opt_state,
+                                                          params)
+            return params, new_bs, opt_state, loss
+
+        sharded_dt, _, sharded_spread = _time_steps(
+            eager_sharded_step, (params, batch_stats, zero_state),
+            (images, labels), max(iters // 2, 4))
+        sharded_disp = _engine_dispatches(
+            eager_sharded_step, (params, batch_stats, zero_state))
+        sharded_metrics = {
+            "sharded_img_s_per_chip": round(batch / sharded_dt / n_chips, 2),
+            "sharded_spread_pct": round(sharded_spread, 1),
+            "sharded_vs_eager": round(eager_dt / sharded_dt, 3),
+            "sharded_engine_dispatches_per_step": sharded_disp,
+        }
+    except Exception as e:
+        sharded_metrics = {"sharded_error": f"{type(e).__name__}: {e}"}
+
+    # per-chip optimizer-state bytes, sharded vs replicated, measured from
+    # live arrays on the 8-device forced-CPU dryrun world (this rig has one
+    # chip; the ratio is topology-independent)
+    try:
+        opt_state_bytes = bench_sharded_memory()
+    except Exception as e:
+        opt_state_bytes = {"error": f"{type(e).__name__}: {e}"}
+
+    # measured 1F1B pipeline bubble (VERDICT r5 gap: overlap story was
+    # schedule math) — 4-stage forced-CPU pipeline
+    try:
+        bubble = bench_pipeline_bubble()
+    except Exception as e:
+        bubble = {"error": f"{type(e).__name__}: {e}"}
+
     # ---- report -----------------------------------------------------------
     spmd_img_s = batch / spmd_dt
     raw_img_s = batch / raw_dt
@@ -651,6 +939,10 @@ def main():
         "eager_replay_vs_spmd": round(replay_img_s / spmd_img_s, 3),
         "replay_counters": replay_counters,
         "eager_gap_attribution": gap_attribution,
+        **sharded_metrics,
+        "optimizer_state_bytes_per_chip": opt_state_bytes,
+        "pipeline_bubble_pct": bubble.get("pipeline_bubble_pct"),
+        "pipeline_bubble_detail": bubble,
         "spmd_spread_pct": round(spmd_spread, 1),
         "achieved_tflops_per_chip": round(tflops_chip, 2),
         "mfu_pct": (round(100.0 * tflops_chip / peak, 2)

@@ -263,6 +263,9 @@ def join() -> int:
 from . import optimizer  # noqa: E402
 DistributedOptimizer = optimizer.DistributedOptimizer
 DistributedDeltaAdasumOptimizer = optimizer.DistributedDeltaAdasumOptimizer
+# the SPMD optax wrapper (hvd.distributed(inner, shard_optimizer=True) is
+# the ZeRO-1 optimizer-state-sharded mode, docs/sharded_optimizer.md)
+distributed = optimizer.distributed
 from .ops.compression import Compression  # noqa: E402
 from . import functions as _functions  # noqa: E402
 broadcast_parameters = _functions.broadcast_parameters
@@ -287,7 +290,7 @@ __all__ = [
     "allreduce_sparse",
     "broadcast_optimizer_state",
     "DistributedOptimizer", "DistributedDeltaAdasumOptimizer",
-    "Compression", "optimizer", "elastic",
+    "distributed", "Compression", "optimizer", "elastic",
     "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max", "Product",
     "HorovodInternalError", "HostsUpdatedInterrupt", "DuplicateNameError",
     "__version__",

@@ -28,6 +28,11 @@ packing) that a static default cannot make per cluster:
   whole steady-state step into one launch beats the grouped path is a
   per-runtime dispatch-overhead fact, so it tunes like the other
   topology-dependent on/off choices)
+- shard_optimizer (ZeRO-1 optimizer-state partitioning, optimizer.py:
+  reduce-scatter + shard-local update + allgather vs allreduce +
+  replicated update — the win depends on model size vs interconnect
+  latency; the knob only steers optimizers whose state is created after
+  the flip, since live shard shapes are frozen at init)
 
 Scoring: the interval between successive ``step_mark`` calls spans one
 full training step (mark fires at grouped-allreduce entry each step), so

@@ -80,6 +80,12 @@ HOROVOD_TPU_META_CACHE_WARMUP = "HOROVOD_TPU_META_CACHE_WARMUP"
 # times, service the whole step with one fused XLA launch; =0 disables
 HOROVOD_TPU_STEP_REPLAY = "HOROVOD_TPU_STEP_REPLAY"
 HOROVOD_TPU_STEP_REPLAY_WARMUP = "HOROVOD_TPU_STEP_REPLAY_WARMUP"
+# ZeRO-1 optimizer-state sharding default for optimizers constructed with
+# sharded=None (DistributedEagerOptimizer): gradients sync via bucketed
+# reduce-scatter + shard-local update + fused allgather instead of
+# allreduce + replicated update (docs/sharded_optimizer.md). Also offered
+# as an autotune categorical; resolved once per optimizer at state init.
+HOROVOD_TPU_SHARD_OPTIMIZER = "HOROVOD_TPU_SHARD_OPTIMIZER"
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # operations.cc:432
 DEFAULT_CYCLE_TIME_MS = 5.0                        # operations.cc:440
@@ -143,6 +149,7 @@ class Config:
     single_launch: bool = True
     step_replay: bool = True
     step_replay_warmup: int = 3
+    shard_optimizer: bool = False
     extra: dict = field(default_factory=dict)
 
     @classmethod
@@ -176,4 +183,5 @@ class Config:
             single_launch=_get_bool(HOROVOD_TPU_SINGLE_LAUNCH, True),
             step_replay=_get_bool(HOROVOD_TPU_STEP_REPLAY, True),
             step_replay_warmup=_get_int(HOROVOD_TPU_STEP_REPLAY_WARMUP, 3),
+            shard_optimizer=_get_bool(HOROVOD_TPU_SHARD_OPTIMIZER, False),
         )

@@ -239,7 +239,8 @@ class HandleManager:
 # matching zero-tensor launch until every rank has joined.
 _KIND_CODES = {"allreduce": 1, "grouped_allreduce": 2, "allgather": 3,
                "broadcast": 4, "alltoall": 5, "reducescatter": 6,
-               "barrier": 7, "adasum": 8, "grouped_broadcast": 9}
+               "barrier": 7, "adasum": 8, "grouped_broadcast": 9,
+               "sharded_step": 10}
 _DTYPE_CODES = {"float32": 1, "float64": 2, "float16": 3, "bfloat16": 4,
                 "int8": 5, "int16": 6, "int32": 7, "int64": 8,
                 "uint8": 9, "uint16": 10, "uint32": 11, "uint64": 12,
@@ -311,6 +312,11 @@ class Engine:
         # every armed stream when this moves
         self.world_version = int(
             os.environ.get("HOROVOD_TPU_WORLD_VERSION", "0") or 0)
+        # ZeRO-1 sharded optimizer steps: update_key -> shard-update closure
+        # (the traceable rs->update->ag middle phase); the replay builder
+        # resolves keys here so a captured sharded step can fuse the update
+        # into the single replayed launch
+        self._sharded_updates: Dict[tuple, Callable] = {}
         # step-capture replay (core/replay.py): records the dispatch stream
         # between step_begin/step_end and re-executes steady-state steps as
         # one fused launch
@@ -455,7 +461,7 @@ class Engine:
         # Pallas-pack / replay choices flip between samples, synchronized
         # across ranks by the pm's rank-0 broadcast at sample boundaries
         for knob in ("hierarchical_allreduce", "hierarchical_allgather",
-                     "single_launch", "step_replay"):
+                     "single_launch", "step_replay", "shard_optimizer"):
             if pm.tunes(knob):
                 setattr(self.config, knob, pm.categorical_value(knob))
 
@@ -634,6 +640,20 @@ class Engine:
         elif kind == "reducescatter":
             self.reducescatter(zero(metas[0]),
                                op=ReduceOp(int(metas[0][0]))).synchronize()
+        elif kind == "sharded_step":
+            # A zero substitute cannot stand in for a sharded optimizer
+            # step: this joined rank OWNS a parameter shard, and the
+            # all-gather leg would publish a garbage (non-updated) shard
+            # into every peer's parameters — silent model corruption. Fail
+            # loudly instead (peers' unmatched collective surfaces as a
+            # HorovodInternalError through _translate_failure).
+            raise HorovodInternalError(
+                "sharded optimizer steps cannot be matched by a join() "
+                "zero substitute: a rank without data still owns a "
+                "parameter shard that must keep receiving real updates. "
+                "Keep stepping with zero gradients instead of join(), or "
+                "use the replicated (sharded=False) optimizer for "
+                "ragged-batch workloads (see docs/sharded_optimizer.md)")
         elif kind == "alltoall":
             code = int(metas[0][0])
             z = zero(metas[0])
@@ -914,6 +934,97 @@ class Engine:
         for i, nm in enumerate(names):
             garr, group = results[i]
             h = Handle(nm, [garr],
+                       lambda gs: self.backend.from_replicated(gs[0]), self,
+                       group=group)
+            self._track(nm, h)
+            handles.append(h)
+        return handles
+
+    def sharded_step(self, tensors: Sequence, update_fn: Callable,
+                     update_key: tuple, state_leaves: Sequence,
+                     name: Optional[str] = None,
+                     op: ReduceOp = ReduceOp.AVERAGE,
+                     prescale_factor: float = 1.0,
+                     postscale_factor: float = 1.0,
+                     buckets: Optional[Sequence] = None) -> List[Handle]:
+        """ZeRO-1 optimizer-state-sharded gradient sync + update: bucket and
+        pack the gradients (fusion logic of grouped_allreduce), reduce-
+        scatter each bucket, run ``update_fn`` on this rank's shards only,
+        and all-gather the updated parameter shards — all of it (after the
+        pack) ONE launch. Same wire bytes as the fused allreduce (RS + AG),
+        1/world_size of the optimizer-update FLOPs and state memory.
+
+        ``update_fn(shards, state_leaves) -> (new_param_shards,
+        new_state_leaves)`` is traced into the program (collective-free,
+        state-shape-stable); ``update_key`` is its stable identity for the
+        builder cache and the replay registry. Returns one handle per
+        gradient (the full updated parameter tensor, replicated by
+        construction) followed by one per state leaf (this rank's new
+        shard-local state).
+
+        ``buckets`` is the caller's FROZEN fusion layout (the sharded
+        optimizer pins it at state-init time so a live autotune move of
+        the fusion threshold cannot invalidate shard-shaped state
+        mid-run); None re-derives from the current threshold."""
+        tensors = [jnp.asarray(t) for t in tensors]
+        state_leaves = [jnp.asarray(s) for s in state_leaves]
+        if not tensors:
+            raise ValueError("sharded_step needs at least one gradient")
+        sub = self._consume_substitute()
+        for t in tensors:
+            _check_average_dtype(t, op)
+        if buckets is None:
+            buckets = bucket_by_size(tensors,
+                                     self.config.fusion_threshold_bytes)
+        bkey = tuple(tuple(b) for b in buckets)
+        # register BEFORE replay interception: a replayed launch resolves
+        # the update closure from this registry at trace time. LRU-bounded
+        # like the builder cache (an armed program only reads the registry
+        # when it first traces, so eviction after arming is harmless).
+        lru_put(self._sharded_updates, update_key, update_fn,
+                self.config.cache_capacity)
+        all_ts = tensors + state_leaves
+        r = self._replay.intercept("sharded_step", all_ts, int(op),
+                                   prescale_factor, postscale_factor, name,
+                                   sub,
+                                   extra=(update_key, len(tensors), bkey))
+        if r is not None:
+            return r
+        self._join_sync("sharded_step",
+                        [_join_meta_row(t, int(op)) for t in tensors],
+                        skip=sub)
+        self._pm_step(sum(t.nbytes for t in tensors))
+        names = [self._register(None if name is None else f"{name}.{i}",
+                                "sharded_step", t.nbytes)
+                 for i, t in enumerate(all_ts)]
+        self._debug_check(names[0], "sharded_step", tensors,
+                          op_code=int(op), wildcard=sub)
+        mesh = self.backend.group_mesh
+        shapes = tuple(tuple(t.shape) for t in tensors)
+        dtypes = tuple(str(t.dtype) for t in tensors)
+        st_shapes = tuple(tuple(s.shape) for s in state_leaves)
+        st_dtypes = tuple(str(s.dtype) for s in state_leaves)
+        pack_fn = self._builder(("pack_group", shapes, dtypes, bkey),
+                                lambda: C.build_pack_group(buckets))
+        self.dispatch_count += 1
+        packed = _translate_failure(pack_fn, *tensors)
+        fn = self._builder(
+            ("sharded_step", op, prescale_factor, postscale_factor,
+             shapes, dtypes, bkey, st_shapes, st_dtypes, update_key),
+            lambda: C.build_sharded_step(
+                mesh, self._axis(), op, shapes, [t.dtype for t in tensors],
+                buckets, st_shapes, st_dtypes, update_fn,
+                prescale_factor, postscale_factor))
+        outs = self._dispatch(
+            names,
+            lambda: fn(*([self.backend.to_global(p, batched=True)
+                          for p in packed]
+                         + [self.backend.world_view(s)
+                            for s in state_leaves])))
+        group = LaunchGroup(outs[-1])
+        handles = []
+        for i, nm in enumerate(names):
+            h = Handle(nm, [outs[i]],
                        lambda gs: self.backend.from_replicated(gs[0]), self,
                        group=group)
             self._track(nm, h)
@@ -1207,13 +1318,36 @@ class Engine:
         self._debug_check(name, "reducescatter", [x], op_code=int(op),
                           wildcard=sub)
         size = self.backend.size()
-        if int(x.shape[0]) % size != 0:
-            raise ValueError("reducescatter requires dim0 divisible by size")
+        if x.ndim == 0:
+            raise ValueError("reducescatter requires a tensor with dim 0")
+        d0 = int(x.shape[0])
+        # Pad dim 0 to divisibility inside the builder and slice the shard
+        # back (the allgather inverse): rank r owns rows
+        # [r*chunk, min((r+1)*chunk, d0)) per the shared ZeRO-1 shard
+        # assignment — trailing ranks get fewer (possibly zero) rows, and
+        # concatenating every rank's shard reproduces the full reduced
+        # tensor exactly.
+        padded, chunk = C.shard_spec(d0, size)
+        pad = padded - d0
         mesh = self.backend.group_mesh
-        fn = self._builder(("reducescatter", op),
-                           lambda: C.build_reducescatter(mesh, self._axis(), op))
+        fn = self._builder(("reducescatter", op, pad),
+                           lambda: C.build_reducescatter(mesh, self._axis(),
+                                                         op, pad_rows=pad))
         out = self._dispatch(name, lambda: fn(self.backend.to_global(x)))
-        return self._single(name, out, replicated=False)
+        if not pad:
+            return self._single(name, out, replicated=False)
+        rank = self.backend.rank()
+        keep = min(chunk, max(d0 - rank * chunk, 0))
+
+        def extract(gs):
+            shard = self.backend.from_global(gs[0])  # (chunk, *s) padded
+            return shard if keep == chunk else shard[:keep]
+
+        h = Handle(name, [out], extract, self)
+        h.recv_sizes = np.array(
+            [min(chunk, max(d0 - r * chunk, 0)) for r in range(size)])
+        self._track(name, h)
+        return h
 
     def barrier(self):
         sub = self._consume_substitute()

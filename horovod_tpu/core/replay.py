@@ -52,6 +52,7 @@ _DIGITS = re.compile(r"\d+")
 
 _REDUCE_KINDS = ("allreduce", "grouped_allreduce")
 _BCAST_KINDS = ("broadcast", "grouped_broadcast")
+_SHARDED_KINDS = ("sharded_step",)
 _MAX_STREAMS = 16  # bound the per-signature table (LRU)
 
 
@@ -67,16 +68,18 @@ class CallSig(NamedTuple):
     post: float
     name: str          # digit-normalized name template
     replayable: bool
+    extra: tuple = ()  # sharded_step: (update_key, n_grads, frozen buckets)
 
 
 def _make_sig(kind: str, tensors, code: int, pre: float, post: float,
-              name: Optional[str], replayable: bool) -> CallSig:
+              name: Optional[str], replayable: bool,
+              extra: tuple = ()) -> CallSig:
     return CallSig(
         kind, int(code),
         tuple(tuple(int(d) for d in t.shape) for t in tensors),
         tuple(str(t.dtype) for t in tensors),
         float(pre), float(post),
-        _DIGITS.sub("#", name or ""), replayable)
+        _DIGITS.sub("#", name or ""), replayable, tuple(extra))
 
 
 class _LeafProxy:
@@ -157,6 +160,7 @@ class _Armed(NamedTuple):
     threshold: int
     hier_local: int
     join_metas: Optional[list]    # np rows for the one-step advertisement
+    join_kind: str = "grouped_allreduce"   # advertisement kind for the rows
 
 
 class StepReplay:
@@ -273,7 +277,8 @@ class StepReplay:
     # -- per-call interception --------------------------------------------
 
     def intercept(self, kind: str, tensors: Sequence, code: int, pre: float,
-                  post: float, name: Optional[str], sub: bool):
+                  post: float, name: Optional[str], sub: bool,
+                  extra: tuple = ()):
         """Called by every engine collective entry point. Returns None to
         proceed on the normal path, or the list of handles servicing the
         call from the (pending) fused launch."""
@@ -286,10 +291,13 @@ class StepReplay:
             if mode in ("replay", "drain"):
                 self._fallback("join substitute dispatched mid-step")
             self._recording.append(_make_sig(kind, tensors, code, pre, post,
-                                             name, replayable=False))
+                                             name, replayable=False,
+                                             extra=extra))
             return None
-        sig = _make_sig(kind, tensors, code, pre, post, name,
-                        replayable=kind in _REDUCE_KINDS + _BCAST_KINDS)
+        sig = _make_sig(
+            kind, tensors, code, pre, post, name,
+            replayable=kind in _REDUCE_KINDS + _BCAST_KINDS + _SHARDED_KINDS,
+            extra=extra)
         self._recording.append(sig)
         if mode == "record":
             return None
@@ -301,11 +309,15 @@ class StepReplay:
                            "completed")
             return None
         # mode == "replay"
-        if kind == "grouped_allreduce":
+        if kind in ("grouped_allreduce", "sharded_step"):
             # program-ordered autotune boundary (the normal grouped path's
             # step_mark); may reenter the engine (parameter broadcast) and
-            # knock us out of replay — re-check after
-            self.engine._pm_step(sum(t.nbytes for t in tensors))
+            # knock us out of replay — re-check after. For sharded steps
+            # only the GRADIENT bytes score (the normal path's convention;
+            # state leaves ride the call but not the wire)
+            n_counted = extra[1] if kind == "sharded_step" else len(tensors)
+            self.engine._pm_step(sum(t.nbytes
+                                     for t in tensors[:n_counted]))
             if self._mode != "replay":
                 return None
         cands = [s for s in self._cands
@@ -381,28 +393,45 @@ class StepReplay:
         if not all(sig.replayable for sig in stream):
             return None
         join_live = cfg.join_enabled and eng.backend.size() > 1
-        # segments: consecutive calls sharing (class, code, scales) fuse
+        # segments: consecutive calls sharing (class, code, scales) fuse;
+        # sharded steps are one segment each (their update closures must
+        # not be merged across calls)
         from .engine import bucket_by_size, _DTYPE_CODES, _JOIN_META_DIMS
         segs: List[dict] = []
         for sig in stream:
-            cls = "reduce" if sig.kind in _REDUCE_KINDS else "bcast"
-            key = (cls, sig.code, sig.pre, sig.post)
-            if not segs or segs[-1]["key"] != key:
-                segs.append({"key": key, "shapes": [], "dtypes": []})
+            if sig.kind in _SHARDED_KINDS:
+                cls = "sharded"
+            elif sig.kind in _REDUCE_KINDS:
+                cls = "reduce"
+            else:
+                cls = "bcast"
+            key = (cls, sig.code, sig.pre, sig.post) + tuple(sig.extra)
+            if cls == "sharded" or not segs or segs[-1]["key"] != key:
+                segs.append({"key": key, "cls": cls, "shapes": [],
+                             "dtypes": [], "extra": sig.extra})
             segs[-1]["shapes"].extend(sig.shapes)
             segs[-1]["dtypes"].extend(sig.dtypes)
         join_metas = None
+        join_kind = "grouped_allreduce"
         if join_live:
-            # Joined peers match the advertisement with a grouped_allreduce
-            # zero substitute, whose wire sequence is the per-bucket reduce
-            # collectives — identical to the replay program's ONLY for a
-            # single reduce segment. Anything else stays unarmed in Join
-            # worlds.
-            if len(segs) != 1 or segs[0]["key"][0] != "reduce":
+            # Joined peers match the advertisement with a zero substitute
+            # whose wire sequence must be identical to the replay program's:
+            # true for a single reduce segment (per-bucket reduce
+            # collectives) and for a single sharded segment (the sharded
+            # advertisement raises on the joined rank, same as the normal
+            # sharded path). Anything else stays unarmed in Join worlds.
+            if len(segs) != 1 or segs[0]["cls"] not in ("reduce", "sharded"):
                 return None
             op_code = segs[0]["key"][1]
+            adv_shapes = segs[0]["shapes"]
+            adv_dtypes = segs[0]["dtypes"]
+            if segs[0]["cls"] == "sharded":
+                join_kind = "sharded_step"
+                n_grads = segs[0]["extra"][1]
+                adv_shapes = adv_shapes[:n_grads]
+                adv_dtypes = adv_dtypes[:n_grads]
             rows = []
-            for shape, dt in zip(segs[0]["shapes"], segs[0]["dtypes"]):
+            for shape, dt in zip(adv_shapes, adv_dtypes):
                 code = _DTYPE_CODES.get(dt)
                 if code is None or len(shape) > _JOIN_META_DIMS:
                     return None
@@ -414,7 +443,21 @@ class StepReplay:
         built = []
         nbytes = 0
         for seg in segs:
-            cls, code, pre, post = seg["key"]
+            cls = seg["cls"]
+            if cls == "sharded":
+                # the bucket layout is the CALLER'S frozen layout (carried
+                # in the sig's extra) — never re-derived from the live
+                # fusion threshold, which may have moved since the sharded
+                # state was initialized (shard shapes are pinned to it)
+                _, op_code, pre, post, update_key, n_grads, bkey = seg["key"]
+                nbytes += sum(
+                    _LeafProxy(s, d).nbytes
+                    for s, d in zip(seg["shapes"][:n_grads],
+                                    seg["dtypes"][:n_grads]))
+                built.append(("sharded", (op_code, update_key, n_grads),
+                              pre, post, 0, tuple(seg["shapes"]), bkey))
+                continue
+            _, code, pre, post = seg["key"]
             proxies = [_LeafProxy(s, d)
                        for s, d in zip(seg["shapes"], seg["dtypes"])]
             nbytes += sum(p.nbytes for p in proxies)
@@ -427,7 +470,7 @@ class StepReplay:
                       ("replay_step", stream, cfg.fusion_threshold_bytes,
                        hier_local),
                       nbytes, cfg.fusion_threshold_bytes, hier_local,
-                      join_metas)
+                      join_metas, join_kind)
 
     def _fallback(self, reason: str):
         self.fallbacks += 1
@@ -465,11 +508,12 @@ class StepReplay:
         if armed.join_metas is not None:
             # one fire-and-forget advertisement for the WHOLE step (the
             # per-op join rounds the recorded path paid, collapsed to one)
-            eng._join_sync("grouped_allreduce", armed.join_metas)
+            eng._join_sync(armed.join_kind, armed.join_metas)
         fn = eng._builder(armed.builder_key,
                           lambda: engine_mod.C.build_replay_step(
                               eng.backend.group_mesh, eng._axis(),
-                              armed.segments))
+                              armed.segments,
+                              sharded_updates=eng._sharded_updates))
         rep_name = f"replay.step.{self._step_token & 1023}"
         if eng.on_enqueue is not None:
             eng.on_enqueue(rep_name, "replay", armed.nbytes)

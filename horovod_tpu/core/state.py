@@ -80,6 +80,13 @@ class GlobalState:
             # the whole steady-state step into one launch beats the grouped
             # path depends on per-dispatch overhead, a per-runtime fact
             categorical += ["step_replay"]
+            # ZeRO-1 optimizer-state sharding (optimizer.py sharded paths):
+            # rs + shard update + ag vs allreduce + replicated update is a
+            # FLOPs/memory-vs-latency trade that depends on model size and
+            # interconnect. NOTE the knob only steers optimizers created
+            # with sharded=None AFTER the flip — live optimizer state
+            # shapes are frozen at their init (optimizer._is_sharded).
+            categorical += ["shard_optimizer"]
             self.parameter_manager = ParameterManager(
                 warmup_samples=cfg.autotune_warmup_samples,
                 steps_per_sample=cfg.autotune_steps_per_sample,
@@ -100,6 +107,7 @@ class GlobalState:
                     "pallas_pack": pack_pallas_enabled(),
                     "single_launch": cfg.single_launch,
                     "step_replay": cfg.step_replay,
+                    "shard_optimizer": cfg.shard_optimizer,
                 })
             self.engine.parameter_manager = self.parameter_manager
 

@@ -92,7 +92,22 @@ def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
 def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0) -> Any:
     """Optimizer state is a pytree under optax — same path as parameters
     (reference needed a separate walker for torch optimizer dicts,
-    torch/functions.py:62)."""
+    torch/functions.py:62).
+
+    A ZeRO-1 sharded state is refused: its leaves are RANK-LOCAL shards
+    (docs/sharded_optimizer.md), so broadcasting rank 0's shards would
+    silently overwrite every rank's distinct master-parameter slice and
+    corrupt the model at the next all-gather. Broadcast the *parameters*
+    and re-run ``opt.init(params)`` instead — that reconstructs a
+    consistent sharded state on every rank."""
+    from .optimizer import ShardedEagerState
+    if isinstance(opt_state, ShardedEagerState):
+        raise ValueError(
+            "broadcast_optimizer_state cannot broadcast a ZeRO-1 sharded "
+            "state: its leaves are rank-local shards, and overwriting them "
+            "with rank 0's would corrupt every other rank's parameter "
+            "slice. Use broadcast_parameters(params) followed by "
+            "opt.init(params) (see docs/sharded_optimizer.md)")
     return broadcast_parameters(opt_state, root_rank)
 
 

@@ -332,10 +332,20 @@ def build_alltoall(mesh: Mesh, axis: str):
     return jax.jit(fn)
 
 
-def build_reducescatter(mesh: Mesh, axis: str, op: ReduceOp = ReduceOp.SUM):
-    """Stacked reduce-scatter: (n, d0, *s) -> (n, d0/n, *s)."""
+def build_reducescatter(mesh: Mesh, axis: str, op: ReduceOp = ReduceOp.SUM,
+                        pad_rows: int = 0):
+    """Stacked reduce-scatter: (n, d0, *s) -> (n, ceil(d0/n), *s).
+
+    ``pad_rows`` zero-pads dim 0 inside the program so totals that do not
+    divide the world size still reduce exactly (the allgather inverse:
+    concatenating every rank's trimmed shard reproduces the full reduced
+    tensor). The caller slices the trailing ranks' shards back to their
+    real row counts (engine.reducescatter extract)."""
     def body(x):
-        return reducescatter_p(x[0], axis, op)[None]
+        v = x[0]
+        if pad_rows:
+            v = jnp.pad(v, [(0, pad_rows)] + [(0, 0)] * (v.ndim - 1))
+        return reducescatter_p(v, axis, op)[None]
 
     fn = _shmap(body, mesh, axis, in_specs=P(axis), out_specs=P(axis))
     return jax.jit(fn)
@@ -529,7 +539,185 @@ def build_pack(shapes, dtype):
     return jax.jit(f)
 
 
-def build_replay_step(mesh: Mesh, axis: str, segments):
+# ---------------------------------------------------------------------------
+# ZeRO-1 sharded gradient sync: grouped reduce-scatter / allgather builders
+# ---------------------------------------------------------------------------
+
+
+def shard_spec(total: int, n: int) -> tuple:
+    """Shard assignment for a flat bucket of ``total`` elements over ``n``
+    ranks: returns ``(padded, shard)`` with ``padded = shard * n`` and
+    ``shard = ceil(total / n)`` — rank r owns the contiguous slice
+    ``[r*shard, (r+1)*shard)`` of the zero-padded buffer. Padding keeps the
+    reduce-scatter/allgather pair exact for bucket totals that do not
+    divide the world size (ZeRO-1, Rajbhandari et al. 2020 §5.1)."""
+    shard = -(-int(total) // int(n)) if n > 0 else int(total)
+    return shard * n, shard
+
+
+def _rs_flat(flat, axis: str, n: int, op: ReduceOp):
+    """Reduce-scatter a flat buffer: pad to divisibility, psum_scatter, and
+    return this rank's shard (shape ``(ceil(len/n),)``). Sum/Average only —
+    the same op restriction as :func:`reducescatter_p`."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"reducescatter supports Sum and Average, got {op!r}")
+    padded, _ = shard_spec(flat.shape[0], n)
+    pad = padded - flat.shape[0]
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    shard = lax.psum_scatter(flat, axis, scatter_dimension=0, tiled=True)
+    if op == ReduceOp.AVERAGE:
+        shard = shard / n
+    return shard
+
+
+def _ag_flat(shard, axis: str, total: int):
+    """Inverse of :func:`_rs_flat`: all-gather the per-rank shards and trim
+    the divisibility padding back off."""
+    full = lax.all_gather(shard, axis, axis=0, tiled=True)
+    if full.shape[0] != total:
+        full = full[:total]
+    return full
+
+
+def _unpack_flat(flat, shapes, sizes, idxs, outs):
+    offset = 0
+    for i in idxs:
+        outs[i] = lax.dynamic_slice_in_dim(
+            flat, offset, sizes[i]).reshape(shapes[i])
+        offset += sizes[i]
+
+
+def build_grouped_reducescatter(mesh: Mesh, axis: str, op: ReduceOp,
+                                shapes, dtypes, buckets,
+                                prescale_factor: float = 1.0,
+                                postscale_factor: float = 1.0):
+    """ONE launch for a whole grouped reduce-scatter: the per-bucket packed
+    buffers (from :func:`build_pack_group`, stacked (n, total_b)) go in, one
+    stacked (n, shard_b) array per bucket comes out — rank r's addressable
+    slice is its reduced shard of the bucket. The sharded-gradient-sync
+    sibling of :func:`build_grouped_allreduce`: same bytes on the wire as
+    the allreduce (an allreduce IS reduce-scatter + allgather), but the
+    caller keeps only 1/n of the reduced elements, which is what lets the
+    optimizer update and its state shrink by the world size (ZeRO-1).
+    Bucket totals need not divide n — shards are over the zero-padded
+    buffer (:func:`shard_spec`)."""
+    _check_bucket_dtypes(dtypes, buckets)
+    n = int(mesh.devices.size)
+
+    def body(*packed):  # per-bucket blocks (1, total_b)
+        outs = []
+        for b, idxs in enumerate(buckets):
+            flat = packed[b][0]
+            if prescale_factor != 1.0:
+                flat = flat * prescale_factor
+            shard = _rs_flat(flat, axis, n, op)
+            if postscale_factor != 1.0:
+                shard = shard * postscale_factor
+            outs.append(shard[None])
+        return tuple(outs)
+
+    fn = _shmap(body, mesh, axis,
+                in_specs=tuple(P(axis) for _ in buckets),
+                out_specs=tuple(P(axis) for _ in buckets))
+    return jax.jit(fn)
+
+
+def build_grouped_allgather(mesh: Mesh, axis: str, shapes, dtypes, buckets):
+    """Inverse of :func:`build_grouped_reducescatter` and the return leg of
+    the sharded optimizer step: per-bucket stacked shards (n, shard_b) in,
+    every tensor of the group out — replicated, unpacked to its natural
+    shape, padding trimmed. One all-gather per bucket in a single
+    program."""
+    _check_bucket_dtypes(dtypes, buckets)
+    sizes = [math.prod(s) for s in shapes]
+    totals = [sum(sizes[i] for i in idxs) for idxs in buckets]
+
+    def body(*shards):  # per-bucket blocks (1, shard_b)
+        outs = [None] * len(shapes)
+        for b, idxs in enumerate(buckets):
+            full = _ag_flat(shards[b][0], axis, totals[b])
+            _unpack_flat(full, shapes, sizes, idxs, outs)
+        return tuple(outs)
+
+    # gathered outputs are identical on every rank but not VMA-inferrable
+    fn = _shmap(body, mesh, axis,
+                in_specs=tuple(P(axis) for _ in buckets),
+                out_specs=tuple(P() for _ in shapes),
+                check_vma=False)
+    return jax.jit(fn)
+
+
+def build_sharded_step(mesh: Mesh, axis: str, op: ReduceOp,
+                       shapes, dtypes, buckets,
+                       state_shapes, state_dtypes, update,
+                       prescale_factor: float = 1.0,
+                       postscale_factor: float = 1.0):
+    """ONE launch for a whole ZeRO-1 optimizer step: per-bucket packed
+    gradient buffers (stacked (n, total_b)) plus this rank's optimizer-state
+    leaves (world-view lifted, genuinely different per rank) go in; the
+    program reduce-scatters each bucket, runs ``update`` on the local shards
+    only (1/n of the optimizer-update FLOPs), all-gathers the updated
+    parameter shards, and unpacks — outputs are the full updated parameter
+    tensors (replicated by construction) followed by the new state leaves
+    (each rank's own shard-local state).
+
+    ``update(shards, state_leaves) -> (new_param_shards, new_state_leaves)``
+    is traced into the program; it must be collective-free and preserve the
+    state leaves' shapes/dtypes (asserted at trace time). The wire sequence
+    is exactly one reduce-scatter and one all-gather per bucket — the same
+    bytes as the fused allreduce, split around the shard-local update.
+    """
+    _check_bucket_dtypes(dtypes, buckets)
+    n = int(mesh.devices.size)
+    sizes = [math.prod(s) for s in shapes]
+    totals = [sum(sizes[i] for i in idxs) for idxs in buckets]
+
+    def body(*args):
+        packed = args[:len(buckets)]
+        state = list(args[len(buckets):])
+        shards = []
+        for b in range(len(buckets)):
+            flat = packed[b][0]
+            if prescale_factor != 1.0:
+                flat = flat * prescale_factor
+            shard = _rs_flat(flat, axis, n, op)
+            if postscale_factor != 1.0:
+                shard = shard * postscale_factor
+            shards.append(shard)
+        new_shards, new_state = update(shards, state)
+        if len(new_state) != len(state):
+            raise ValueError(
+                f"sharded update changed the state leaf count "
+                f"({len(state)} -> {len(new_state)})")
+        for old, new in zip(state, new_state):
+            if old.shape != new.shape or old.dtype != new.dtype:
+                raise ValueError(
+                    f"sharded update changed a state leaf's shape/dtype "
+                    f"({old.shape}/{old.dtype} -> {new.shape}/{new.dtype}); "
+                    f"shard-local state must be shape-stable")
+        outs = [None] * len(shapes)
+        for b, idxs in enumerate(buckets):
+            full = _ag_flat(new_shards[b], axis, totals[b])
+            _unpack_flat(full, shapes, sizes, idxs, outs)
+        return tuple(outs) + tuple(new_state)
+
+    # packed grads arrive stacked; state leaves are world-view claims (each
+    # rank's own shard presented as 'replicated'); gathered params are
+    # replicated by construction, new state is per-rank — neither is
+    # VMA-inferrable, same as the replay builder
+    fn = _shmap(body, mesh, axis,
+                in_specs=tuple(P(axis) for _ in buckets)
+                + tuple(P() for _ in state_shapes),
+                out_specs=tuple(P() for _ in shapes)
+                + tuple(P() for _ in state_shapes),
+                check_vma=False)
+    return jax.jit(fn)
+
+
+def build_replay_step(mesh: Mesh, axis: str, segments,
+                      sharded_updates=None):
     """ONE launch for a whole captured eager step (core/replay.py): every
     recorded collective call's pack, reduction/broadcast, and unpack fused
     into a single jitted program — the XLA answer to CUDA-graph capture of
@@ -547,10 +735,16 @@ def build_replay_step(mesh: Mesh, axis: str, segments):
 
     Args:
       segments: sequence of ``(cls, code, pre, post, local_size, shapes,
-        buckets)`` tuples — ``cls`` is ``"reduce"`` (code = ReduceOp) or
-        ``"bcast"`` (code = root rank); ``shapes`` are the segment's tensor
-        shapes in order; ``buckets`` index into them (dtype-uniform, from
-        ``bucket_by_size``).
+        buckets)`` tuples — ``cls`` is ``"reduce"`` (code = ReduceOp),
+        ``"bcast"`` (code = root rank), or ``"sharded"`` (a ZeRO-1
+        optimizer step: code = ``(op, update_key, n_grads)``, ``shapes``
+        lists the gradient shapes followed by the shard-local state-leaf
+        shapes, ``buckets`` index into the first ``n_grads`` shapes, and
+        ``update_key`` resolves the shard-update closure in
+        ``sharded_updates``); other ``shapes``/``buckets`` as before.
+      sharded_updates: mapping update_key -> ``update(shards, state)``
+        closure (engine._sharded_updates); required when any segment is
+        ``"sharded"``.
     """
     n = int(mesh.devices.size)
     n_tensors = sum(len(seg[5]) for seg in segments)
@@ -560,6 +754,36 @@ def build_replay_step(mesh: Mesh, axis: str, segments):
         base = 0
         for cls, code, pre, post, local_size, shapes, buckets in segments:
             sizes = [math.prod(s) for s in shapes]
+            if cls == "sharded":
+                # rs -> shard-local update -> ag, fused in-stream: the
+                # sharded eager step replays as part of the same single
+                # launch as every other recorded call
+                op_code, update_key, n_grads = code
+                op = ReduceOp(op_code)
+                state = [ts[base + j] for j in range(n_grads, len(shapes))]
+                shards = []
+                for idxs in buckets:
+                    flat = jnp.concatenate(
+                        [jnp.ravel(ts[base + i]) for i in idxs])
+                    if pre != 1.0:
+                        flat = flat * pre
+                    shard = _rs_flat(flat, axis, n, op)
+                    if post != 1.0:
+                        shard = shard * post
+                    shards.append(shard)
+                new_shards, new_state = sharded_updates[update_key](
+                    shards, state)
+                for b, idxs in enumerate(buckets):
+                    total = sum(sizes[i] for i in idxs)
+                    full = _ag_flat(new_shards[b], axis, total)
+                    seg_outs = [None] * len(shapes)
+                    _unpack_flat(full, shapes, sizes, idxs, seg_outs)
+                    for i in idxs:
+                        outs[base + i] = seg_outs[i]
+                for j, leaf in enumerate(new_state):
+                    outs[base + n_grads + j] = leaf
+                base += len(shapes)
+                continue
             if cls == "reduce":
                 reduce_flat = _make_reduce_flat(axis, ReduceOp(code), n,
                                                 local_size)

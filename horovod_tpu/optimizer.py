@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from .common import env as _env
 from .common.lru import lru_get, lru_put
 from .common.reduce_ops import ReduceOp, Average, Sum, Adasum
 from .ops import collectives as C
@@ -122,7 +123,10 @@ class DistributedState(NamedTuple):
 def distributed(inner: optax.GradientTransformation, axis_name: str = "world",
                 op: ReduceOp = Average, compression=Compression.none,
                 backward_passes_per_step: int = 1,
-                axis_size: Optional[int] = None) -> optax.GradientTransformation:
+                axis_size: Optional[int] = None,
+                shard_optimizer: bool = False,
+                fusion_threshold_bytes: Optional[int] = None
+                ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so updates see cross-replica-reduced gradients.
 
     Use inside pjit/shard_map-traced train steps:
@@ -132,9 +136,24 @@ def distributed(inner: optax.GradientTransformation, axis_name: str = "world",
     With ``backward_passes_per_step=k`` the transformation accumulates k local
     gradients between reductions (torch/optimizer.py backward_passes_per_step)
     and emits zero updates on the intermediate passes.
+
+    ``shard_optimizer=True`` selects the ZeRO-1 optimizer-state-sharded sync
+    (Rajbhandari et al., 2020): gradients are packed into fusion buckets and
+    reduce-scattered instead of allreduced, ``inner`` updates only this
+    rank's 1/axis_size shard of each bucket (optimizer-update FLOPs and
+    optimizer state shrink by the world size), and the update deltas return
+    via a fused all-gather — same wire bytes as the allreduce. Requires a
+    static ``axis_size``, op Average|Sum, no compression, and an elementwise
+    ``inner`` (anything computing cross-parameter statistics, e.g.
+    clip_by_global_norm, would see only the local shard). See
+    docs/sharded_optimizer.md.
     """
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
+    if shard_optimizer:
+        return _distributed_zero1(inner, axis_name, op, compression,
+                                  backward_passes_per_step, axis_size,
+                                  fusion_threshold_bytes)
 
     def init_fn(params):
         accum = jax.tree_util.tree_map(jnp.zeros_like, params) \
@@ -174,8 +193,187 @@ def distributed(inner: optax.GradientTransformation, axis_name: str = "world",
 
 
 # ---------------------------------------------------------------------------
+# ZeRO-1 optimizer-state sharding (shared helpers + SPMD path)
+# ---------------------------------------------------------------------------
+
+
+class _SizeProxy:
+    """shape/dtype stand-in with the ``.nbytes``/``.dtype`` surface
+    ``bucket_by_size`` consumes — lets the bucket layout be computed from
+    traced leaves (tracers) and from concrete arrays identically."""
+    __slots__ = ("shape", "dtype", "nbytes")
+
+    def __init__(self, shape, dtype):
+        import numpy as np
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(str(dtype))
+        self.nbytes = (int(np.prod(self.shape)) if self.shape else 1) \
+            * self.dtype.itemsize
+
+
+def _zero1_layout(leaves, n: int, threshold: int):
+    """Deterministic bucket layout for ZeRO-1: fusion buckets over the
+    flattened leaves (the existing bucket_by_size logic) plus per-bucket
+    (sizes, total, shard) shard assignment. Depends only on shapes/dtypes
+    and the threshold, so init and every update agree."""
+    from .core.engine import bucket_by_size
+    import math as _math
+    proxies = [_SizeProxy(l.shape, l.dtype) for l in leaves]
+    buckets = bucket_by_size(proxies, threshold)
+    layout = []
+    for idxs in buckets:
+        sizes = [int(_math.prod(proxies[i].shape)) if proxies[i].shape
+                 else 1 for i in idxs]
+        total = sum(sizes)
+        _, shard = C.shard_spec(total, n)
+        layout.append((tuple(idxs), tuple(sizes), total, shard))
+    return layout
+
+
+def _pack_bucket(leaves, idxs, scale=None):
+    parts = []
+    for i in idxs:
+        v = jnp.ravel(leaves[i])
+        if scale is not None and scale[i] != 1.0:
+            v = v * scale[i]
+        parts.append(v)
+    return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
+def _in_axis(axis_name: str) -> bool:
+    """Whether we are being traced inside a mesh context where
+    ``axis_name`` is bound (shard_map manual region)."""
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except Exception:
+        return False
+
+
+def zero1_state_specs(state, axis_name: str):
+    """PartitionSpec tree for a ZeRO-1 optimizer state: shard-local array
+    leaves travel stacked over ``axis_name`` (each rank contributes its own
+    shard), scalars (e.g. optax step counts) stay replicated. Use as the
+    shard_map in/out spec for the state returned by
+    ``distributed(..., shard_optimizer=True)``."""
+    from jax.sharding import PartitionSpec as P
+    return jax.tree_util.tree_map(
+        lambda l: P() if getattr(l, "ndim", 0) == 0 else P(axis_name), state)
+
+
+def _distributed_zero1(inner: optax.GradientTransformation, axis_name: str,
+                       op: ReduceOp, compression,
+                       backward_passes_per_step: int,
+                       axis_size: Optional[int],
+                       fusion_threshold_bytes: Optional[int]
+                       ) -> optax.GradientTransformation:
+    """SPMD ZeRO-1: reduce-scatter per fusion bucket, shard-local inner
+    update, fused all-gather of the update deltas (see :func:`distributed`
+    ``shard_optimizer=True``)."""
+    if op not in (Average, Sum):
+        raise ValueError("shard_optimizer=True supports op=Average|Sum only "
+                         "(Adasum mixes whole updates, not shards)")
+    if compression is not Compression.none:
+        raise ValueError("shard_optimizer=True does not compose with wire "
+                         "compression (the packed buffers are dtype-uniform "
+                         "already); use Compression.none")
+    if backward_passes_per_step != 1:
+        raise ValueError("shard_optimizer=True requires "
+                         "backward_passes_per_step=1 (accumulate locally "
+                         "before calling update instead)")
+    if axis_size is None:
+        raise ValueError("shard_optimizer=True needs a static axis_size "
+                         "(shard shapes must be known at trace time)")
+    n = int(axis_size)
+    threshold = int(fusion_threshold_bytes
+                    or _env.DEFAULT_FUSION_THRESHOLD_BYTES)
+
+    def _shards_of(leaves, layout, scale=None, reduce_op=None):
+        """Pack each bucket and either reduce-scatter it (gradients,
+        ``reduce_op`` set) or slice this rank's shard (parameters)."""
+        out = []
+        for idxs, sizes, total, shard in layout:
+            flat = _pack_bucket(leaves, idxs, scale)
+            if reduce_op is not None:
+                out.append(C._rs_flat(flat, axis_name, n, reduce_op))
+            else:
+                pad = shard * n - total
+                if pad:
+                    flat = jnp.concatenate(
+                        [flat, jnp.zeros((pad,), flat.dtype)])
+                idx = jax.lax.axis_index(axis_name)
+                out.append(jax.lax.dynamic_slice_in_dim(
+                    flat, idx * shard, shard))
+        return out
+
+    def init_fn(params):
+        leaves = [jnp.asarray(l) for l in
+                  jax.tree_util.tree_leaves(params)]
+        layout = _zero1_layout(leaves, n, threshold)
+        if _in_axis(axis_name):
+            shards = _shards_of(leaves, layout)
+        else:
+            # outside the mesh axis the local shard values are unknowable;
+            # zero placeholders are exact for the supported (elementwise,
+            # zeros-initialized) inner family — init inside the shard_map'd
+            # step to materialize true shard values
+            shards = [jnp.zeros((shard,), leaves[idxs[0]].dtype)
+                      for idxs, _, _, shard in layout]
+        return DistributedState(inner.init(shards), None,
+                                jnp.zeros((), jnp.int32))
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("shard_optimizer=True needs params passed to "
+                             "update (the shard-local step reads them)")
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        p_leaves = jax.tree_util.tree_leaves(params)
+        layout = _zero1_layout(leaves, n, threshold)
+        # Pre-summed (unvarying) leaves — the shard_map transpose of
+        # replicated params — have already mixed the replicas; feeding one
+        # to psum_scatter would count it n times. Pre-dividing by n makes
+        # the rs exact for them (n identical g/n contributions sum back to
+        # g), so every leaf rides the same packed reduce-scatter.
+        tracking = _vma_tracking_active(axis_name)
+        scale = [1.0 / n if (tracking and not _is_varying(g, axis_name))
+                 else 1.0 for g in leaves]
+        grad_shards = _shards_of(leaves, layout, scale=scale, reduce_op=op)
+        param_shards = _shards_of(p_leaves, layout)
+        upd_shards, new_inner = inner.update(grad_shards, state.inner_state,
+                                             param_shards)
+        outs = [None] * len(leaves)
+        for b, (idxs, sizes, total, shard) in enumerate(layout):
+            full = C._ag_flat(upd_shards[b], axis_name, total)
+            size_by_leaf = {i: s for i, s in zip(idxs, sizes)}
+            offset = 0
+            for i in idxs:
+                outs[i] = jax.lax.dynamic_slice_in_dim(
+                    full, offset, size_by_leaf[i]).reshape(leaves[i].shape)
+                offset += size_by_leaf[i]
+        updates = jax.tree_util.tree_unflatten(treedef, outs)
+        return updates, DistributedState(new_inner, state.accum, state.count)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+# ---------------------------------------------------------------------------
 # Eager process-parallel path
 # ---------------------------------------------------------------------------
+
+class ShardedEagerState(NamedTuple):
+    """ZeRO-1 eager optimizer state: everything in here is sized to THIS
+    rank's 1/world_size shard of each fusion bucket. ``shards`` is the
+    authoritative flat master copy of the parameters (the full tensors the
+    step returns are its all-gather); ``inner_state`` is the wrapped optax
+    state over those shard vectors."""
+    inner_state: Any
+    shards: tuple       # per-bucket flat (ceil(total/n),) parameter shards
+
+
+import itertools as _itertools
+
+_ZERO1_TOKENS = _itertools.count()
+
 
 class DistributedEagerOptimizer:
     """Horovod-style eager optimizer wrapper for one-process-per-chip training.
@@ -202,11 +400,24 @@ class DistributedEagerOptimizer:
     (indices, values) and recombined with a jitted scatter-add — wire bytes
     scale with k·d instead of vocab·d, and the duplicate-combine never
     leaves the device (VERDICT r3 item 9).
+
+    ``sharded=True`` selects ZeRO-1 optimizer-state partitioning
+    (Rajbhandari et al., 2020; docs/sharded_optimizer.md): gradients sync
+    via bucketed reduce-scatter, the optax update runs only on this rank's
+    1/world_size shard (``init`` materializes only the local state shard +
+    flat parameter master copy), and updated params return via a fused
+    allgather — same wire bytes as the allreduce, optimizer state and
+    update FLOPs divided by the world size, bitwise-identical trajectories
+    for elementwise inner transforms. Steady-state steps replay as ONE
+    fused launch. Requires op Average|Sum, no compression/sparse_rows;
+    ``sharded=None`` defers to HOROVOD_TPU_SHARD_OPTIMIZER (also an
+    autotune categorical).
     """
 
     def __init__(self, inner: optax.GradientTransformation, op: ReduceOp = Average,
                  compression=Compression.none, backward_passes_per_step: int = 1,
-                 sparse_rows: Optional[dict] = None):
+                 sparse_rows: Optional[dict] = None,
+                 sharded: Optional[bool] = None):
         self.inner = inner
         self.op = op
         self.compression = compression
@@ -214,6 +425,28 @@ class DistributedEagerOptimizer:
         self.sparse_rows = dict(sparse_rows or {})
         if self.sparse_rows and op not in (Average, Sum):
             raise ValueError("sparse_rows supports op=Average|Sum only")
+        # ZeRO-1 optimizer-state sharding (docs/sharded_optimizer.md):
+        # None defers to the HOROVOD_TPU_SHARD_OPTIMIZER config knob (also
+        # an autotune categorical), resolved once at state init so a knob
+        # flip mid-run cannot invalidate live state shapes.
+        self._sharded_arg = sharded
+        self._sharded: Optional[bool] = (bool(sharded)
+                                         if sharded is not None else None)
+        # stable identity for the engine's sharded-step builder cache:
+        # id(self) could be recycled by the allocator onto a DIFFERENT
+        # optimizer (stale compiled update program); a monotonic token
+        # cannot
+        self._zero1_token = next(_ZERO1_TOKENS)
+        if sharded:
+            if op not in (Average, Sum):
+                raise ValueError(
+                    "sharded=True supports op=Average|Sum only")
+            if compression is not Compression.none:
+                raise ValueError(
+                    "sharded=True does not compose with wire compression")
+            if self.sparse_rows:
+                raise ValueError(
+                    "sharded=True does not compose with sparse_rows")
         self._accum = None
         self._count = 0
         self._step = 0
@@ -224,10 +457,126 @@ class DistributedEagerOptimizer:
         self._apply_cache = {}
         self._extract_cache = {}
         self._ks_cache = {}
+        self._layout_cache = {}   # frozen ZeRO-1 bucket layouts per tree
         self._cache_cap = 16
 
+    def _is_sharded(self) -> bool:
+        if self._sharded is None:
+            from .core.state import global_state
+            st = global_state()
+            self._sharded = bool(st.initialized
+                                 and st.config.shard_optimizer)
+            if self._sharded and (self.op not in (Average, Sum)
+                                  or self.compression is not Compression.none
+                                  or self.sparse_rows):
+                # config-driven opt-in must not silently change an
+                # incompatible optimizer; fall back to replicated
+                self._sharded = False
+        return self._sharded
+
     def init(self, params):
-        return self.inner.init(params)
+        if not self._is_sharded():
+            return self.inner.init(params)
+        return self._sharded_init(params)
+
+    # -- ZeRO-1 sharded path (docs/sharded_optimizer.md) -------------------
+
+    def _sharded_layout(self, leaves, treedef):
+        """Bucket layout for this tree shape, FROZEN at first computation
+        (state init): shard-shaped optimizer state pins the layout, so a
+        later autotune move of the fusion threshold must not re-bucket a
+        live run (it would either crash the shape validation or, worse,
+        land mid-call). LRU-cached, which also keeps the O(leaves) layout
+        walk off the per-step hot path."""
+        key = (treedef,
+               tuple(tuple(l.shape) for l in leaves),
+               tuple(str(l.dtype) for l in leaves))
+        layout = self._cache_get(self._layout_cache, key)
+        if layout is None:
+            eng = self._engine()
+            layout = self._cache_put(
+                self._layout_cache, key,
+                _zero1_layout(leaves, eng.backend.size(),
+                              eng.config.fusion_threshold_bytes))
+        return layout
+
+    def _sharded_init(self, params):
+        """Materialize ONLY this rank's optimizer-state shard: the params
+        are packed into fusion buckets, this rank's 1/world_size slice of
+        each padded bucket becomes the flat master copy, and the inner
+        optax state is created over those shard vectors — optimizer-state
+        memory per rank is ceil(total/n) per bucket instead of total."""
+        eng = self._engine()
+        rank = eng.backend.rank()
+        p_leaves, treedef = jax.tree_util.tree_flatten(params)
+        leaves = [jnp.asarray(l) for l in p_leaves]
+        layout = self._sharded_layout(leaves, treedef)
+        shards = []
+        for idxs, sizes, total, shard in layout:
+            flat = _pack_bucket(leaves, idxs)
+            pad = shard * eng.backend.size() - total
+            if pad:
+                flat = jnp.concatenate([flat,
+                                        jnp.zeros((pad,), flat.dtype)])
+            shards.append(jax.lax.dynamic_slice_in_dim(
+                flat, rank * shard, shard))
+        return ShardedEagerState(self.inner.init(shards), tuple(shards))
+
+    def _sharded_update_and_apply(self, grads, opt_state, params):
+        """One engine ``sharded_step`` per training step (bracketed by the
+        replay markers): pack -> per-bucket reduce-scatter -> inner update
+        on this rank's shards -> fused all-gather of the updated parameter
+        shards. Steady state replays as ONE fused launch."""
+        if not isinstance(opt_state, ShardedEagerState):
+            raise ValueError(
+                "sharded optimizer got a non-sharded state; create it with "
+                "this optimizer's init() (or pass sharded=False)")
+        eng = self._engine()
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        leaves = [jnp.asarray(l) for l in leaves]
+        layout = self._sharded_layout(leaves, treedef)
+        for b, (idxs, sizes, total, shard) in enumerate(layout):
+            got = int(opt_state.shards[b].shape[0]) \
+                if b < len(opt_state.shards) else -1
+            if got != shard or len(layout) != len(opt_state.shards):
+                raise ValueError(
+                    f"sharded state layout mismatch (bucket {b}: state "
+                    f"shard {got}, expected {shard}): the fusion "
+                    f"threshold, tree structure, or world size changed "
+                    f"after init(); re-create the optimizer state "
+                    f"(elastic resets must re-run opt.init on the "
+                    f"restored params)")
+        state_leaves, state_treedef = jax.tree_util.tree_flatten(opt_state)
+        inner = self.inner
+
+        def shard_update(grad_shards, st_leaves):
+            st = jax.tree_util.tree_unflatten(state_treedef, st_leaves)
+            updates, new_inner = inner.update(list(grad_shards),
+                                              st.inner_state,
+                                              list(st.shards))
+            new_shards = [p + u for p, u in zip(st.shards, updates)]
+            new_state = ShardedEagerState(new_inner, tuple(new_shards))
+            return new_shards, jax.tree_util.tree_leaves(new_state)
+
+        update_key = ("zero1", self._zero1_token, treedef, state_treedef)
+        eng.step_begin()
+        try:
+            # the FROZEN layout's buckets ride along so a live fusion-
+            # threshold move (autotune) can never re-bucket against the
+            # shard-shaped state
+            handles = eng.sharded_step(
+                leaves, shard_update, update_key, state_leaves,
+                name=f"grad.zero.s{self._step}", op=self.op,
+                buckets=[list(idxs) for idxs, _, _, _ in layout])
+        finally:
+            eng.step_end()
+        self._step = (self._step + 1) % 1024
+        n = len(leaves)
+        new_params = jax.tree_util.tree_unflatten(
+            treedef, [h.result() for h in handles[:n]])
+        new_state = jax.tree_util.tree_unflatten(
+            state_treedef, [h.result() for h in handles[n:]])
+        return new_params, new_state
 
     def _cache_get(self, cache, key):
         return lru_get(cache, key)
@@ -421,6 +770,8 @@ class DistributedEagerOptimizer:
             grads = self._accum
             self._accum = None
             self._count = 0
+        if self._is_sharded():
+            return self._sharded_update_and_apply(grads, opt_state, params)
         eng = self._engine()
         size = eng.backend.size()
         leaves, treedef = jax.tree_util.tree_flatten(grads)
@@ -436,10 +787,14 @@ class DistributedEagerOptimizer:
 
 def DistributedOptimizer(inner: optax.GradientTransformation, op: ReduceOp = Average,
                          compression=Compression.none,
-                         backward_passes_per_step: int = 1):
-    """Reference-named factory (torch/optimizer.py:367 DistributedOptimizer)."""
+                         backward_passes_per_step: int = 1,
+                         sharded: Optional[bool] = None):
+    """Reference-named factory (torch/optimizer.py:367 DistributedOptimizer).
+    ``sharded=True`` selects ZeRO-1 optimizer-state partitioning (see
+    :class:`DistributedEagerOptimizer` and docs/sharded_optimizer.md)."""
     return DistributedEagerOptimizer(inner, op=op, compression=compression,
-                                     backward_passes_per_step=backward_passes_per_step)
+                                     backward_passes_per_step=backward_passes_per_step,
+                                     sharded=sharded)
 
 
 # ---------------------------------------------------------------------------

@@ -243,6 +243,104 @@ def test_replay_step_multi_segment_bounded_collectives():
     np.testing.assert_allclose(np.asarray(outs[3]), np.ones((4,)))  # bcast
 
 
+def test_grouped_reducescatter_one_collective_per_bucket():
+    """ZeRO-1 sync leg: the grouped reduce-scatter program must lower to
+    exactly one reduce-scatter per fusion bucket (no stray allreduce), and
+    the grouped allgather inverse must reconstruct the reduced values
+    through exactly one all-gather per bucket — padding included (totals
+    192 and 100 do not divide 8)."""
+    mesh = _world_mesh()
+    shapes = tuple((64,) for _ in range(3)) + ((25,), (75,))
+    buckets = [[0, 1, 2], [3, 4]]
+    rs = C.build_grouped_reducescatter(mesh, "world", ReduceOp.SUM, shapes,
+                                       [jnp.float32] * 5, buckets)
+    rng = np.random.RandomState(0)
+    data = [rng.randn(8, 192).astype(np.float32),
+            rng.randn(8, 100).astype(np.float32)]
+    args = [jax.device_put(jnp.asarray(d), NamedSharding(mesh, P("world")))
+            for d in data]
+    hlo = _hlo(rs, *args)
+    assert _count(r"reduce-scatter(?:-start)?\(", hlo) == 2, hlo[:400]
+    assert _count(r"all-reduce(?:-start)?\(", hlo) == 0
+    shards = rs(*args)
+    ag = C.build_grouped_allgather(mesh, "world", shapes,
+                                   [jnp.float32] * 5, buckets)
+    hlo = _hlo(ag, *shards)
+    assert _count(r"all-gather(?:-start)?\(", hlo) == 2
+    assert _count(r"all-reduce(?:-start)?\(", hlo) == 0
+    outs = ag(*shards)
+    flat0 = data[0].sum(axis=0)
+    for k in range(3):
+        np.testing.assert_allclose(np.asarray(outs[k]),
+                                   flat0[k * 64:(k + 1) * 64], rtol=1e-5)
+    flat1 = data[1].sum(axis=0)
+    np.testing.assert_allclose(np.asarray(outs[3]), flat1[:25], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs[4]), flat1[25:], rtol=1e-5)
+
+
+def test_sharded_replay_step_structure():
+    """ISSUE 2 CI satellite: the sharded replay step — a captured ZeRO-1
+    eager step — lowers to exactly one reduce-scatter and one all-gather
+    per fusion bucket, with NO stray all-reduce (the fusion contract of
+    the rs -> shard-update -> ag pipeline)."""
+    from jax.sharding import NamedSharding
+    mesh = _world_mesh()
+    grad_shapes = tuple((7, 3) for _ in range(10)) + tuple((11,) for _ in range(4))
+    n_grads = len(grad_shapes)
+    # a momentum-style shard state leaf per bucket (2 buckets below) plus
+    # the flat parameter master shards
+    buckets = ((0, 1, 2, 3, 4, 5, 6, 7, 8, 9), (10, 11, 12, 13))
+    totals = [210, 44]
+    shard_sizes = [-(-t // 8) for t in totals]
+    state_shapes = tuple((s,) for s in shard_sizes) * 2  # mu + master copy
+    shapes = grad_shapes + state_shapes
+
+    def update(shards, state):
+        mu = state[:2]
+        master = state[2:]
+        new_mu = [0.9 * m + s for m, s in zip(mu, shards)]
+        new_master = [p - 0.1 * m for p, m in zip(master, new_mu)]
+        return list(new_master), new_mu + new_master
+
+    segments = (("sharded", (int(ReduceOp.SUM), "upd", n_grads),
+                 1.0, 1.0, 0, shapes, buckets),)
+    fn = C.build_replay_step(mesh, "world", segments,
+                             sharded_updates={"upd": update})
+    rep = NamedSharding(mesh, P())
+    args = [jax.device_put(jnp.ones(s, jnp.float32), rep) for s in shapes]
+    hlo = _hlo(fn, *args)
+    n_rs = _count(r"reduce-scatter(?:-start)?\(", hlo)
+    n_ag = _count(r"all-gather(?:-start)?\(", hlo)
+    n_ar = _count(r"all-reduce(?:-start)?\(", hlo)
+    assert n_rs == 2, f"expected one reduce-scatter per bucket (2), got {n_rs}"
+    assert n_ag == 2, f"expected one all-gather per bucket (2), got {n_ag}"
+    assert n_ar == 0, f"expected NO stray all-reduce, got {n_ar}"
+    # numerics on the replicated claim: 8 identical rank contributions sum
+    # to 8; mu' = 0.9*1 + 8 = 8.9; master' = 1 - 0.1*8.9 = 0.11
+    outs = fn(*args)
+    np.testing.assert_allclose(np.asarray(outs[0]),
+                               np.full((7, 3), 0.11), rtol=1e-5)
+    # new mu state leaf (first state output) = 0.9*1 + 8
+    np.testing.assert_allclose(np.asarray(outs[n_grads]),
+                               np.full((shard_sizes[0],), 8.9), rtol=1e-6)
+
+
+def test_reducescatter_builder_pads_odd_dim0():
+    """Engine satellite: dim0=7 over 8 ranks — the builder pads to 8 rows
+    inside the program; concatenating the per-rank shards (trimmed of the
+    zero tail) reconstructs the full reduced tensor."""
+    mesh = _world_mesh()
+    fn = C.build_reducescatter(mesh, "world", ReduceOp.SUM, pad_rows=1)
+    rng = np.random.RandomState(1)
+    data = rng.randn(8, 7, 3).astype(np.float32)
+    out = fn(jax.device_put(jnp.asarray(data),
+                            NamedSharding(mesh, P("world"))))
+    got = np.asarray(out)            # (8, 1, 3): one padded row per rank
+    expect = data.sum(axis=0)
+    np.testing.assert_allclose(got[:7, 0], expect, rtol=1e-5)
+    np.testing.assert_allclose(got[7, 0], 0.0, atol=1e-6)
+
+
 def test_grouped_allreduce_rejects_mixed_dtype_bucket():
     """The dtypes parameter now enforces the bucket_by_size contract
     (ADVICE r5): a hand-rolled mixed-dtype bucket fails loudly."""

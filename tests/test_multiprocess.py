@@ -97,6 +97,12 @@ def _worker_alltoall_rs():
     rs = np.asarray(hvd.reducescatter(
         np.arange(size * 3, dtype=np.float32).reshape(size, 3), name="rs"))
     out["rs"] = rs.tolist()
+    # odd-length reducescatter (ISSUE 2 satellite): dim0=5 does not divide
+    # np=2 — the builder pads internally; rank0 keeps ceil(5/2)=3 rows,
+    # rank1 the remaining 2
+    rs_odd = np.asarray(hvd.reducescatter(
+        np.arange(5 * 2, dtype=np.float32).reshape(5, 2), name="rs.odd"))
+    out["rs_odd"] = rs_odd.tolist()
     return out
 
 
@@ -114,6 +120,10 @@ def test_two_process_alltoall_reducescatter():
     # reducescatter of identical (2,3) tensors: row r summed → 2x values
     assert r0["rs"] == [[0.0, 2.0, 4.0]], r0
     assert r1["rs"] == [[6.0, 8.0, 10.0]], r1
+    # odd dim0: both ranks submitted identical (5,2) tensors -> doubled
+    # rows; rank0 holds rows 0-2, rank1 rows 3-4, nothing lost to padding
+    assert r0["rs_odd"] == [[0.0, 2.0], [4.0, 6.0], [8.0, 10.0]], r0
+    assert r1["rs_odd"] == [[12.0, 14.0], [16.0, 18.0]], r1
 
 
 def _elastic_fn(total):
@@ -366,19 +376,51 @@ def _worker_chained_optimizer():
     blocks = eng.host_blocks - blocks0
     fetches = eng.host_fetches - fetches0
     jax.block_until_ready(params)
+    # --- ZeRO-1 sharded phase (same worker: process spawns are the
+    # suite's dominant cost): the sharded trajectory must match the dense
+    # one exactly (both average the same cross-rank gradients), stay in
+    # lockstep, and hold ~half the inner optimizer-state bytes per rank.
+    from horovod_tpu.optimizer import DistributedEagerOptimizer as _DEO
+    sopt = _DEO(optax.sgd(0.1), sharded=True)
+    sp = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    ss = sopt.init(sp)
+    for _ in range(13):   # 3 warmup + 10 measured steps of the dense loop
+        sp, ss = sopt.update_and_apply(grad_fn(sp, x), ss, sp)
+    jax.block_until_ready(sp["w"])
+    # state-shrink check on a stateful inner (plain sgd has no state):
+    # init-only, no extra training steps
+    mom = optax.sgd(0.1, momentum=0.9)
+    dense_state_bytes = sum(
+        l.nbytes for l in jax.tree_util.tree_leaves(mom.init(sp)))
+    shard_state_bytes = sum(
+        l.nbytes for l in jax.tree_util.tree_leaves(
+            _DEO(mom, sharded=True).init(sp).inner_state))
+    sharded_err = float(max(
+        np.max(np.abs(np.asarray(a) - np.asarray(b)))
+        for a, b in zip(jax.tree_util.tree_leaves(params),
+                        jax.tree_util.tree_leaves(sp))))
     return {"rank": rank, "host_blocks": blocks, "host_fetches": fetches,
             "w": np.asarray(params["w"]).tolist(),
-            "finite": bool(np.isfinite(np.asarray(params["w"])).all())}
+            "finite": bool(np.isfinite(np.asarray(params["w"])).all()),
+            "sharded_err": sharded_err,
+            "dense_state_bytes": dense_state_bytes,
+            "shard_state_bytes": shard_state_bytes}
 
 
 @pytest.mark.integration
 def test_chained_eager_optimizer_no_host_blocks():
+    """Dense phase: zero host blocks/fetches (VERDICT r3 item 1a). Sharded
+    phase (ISSUE 2): same trajectory as dense from the same start, with the
+    per-rank inner optimizer state halved (ZeRO-1 shard)."""
     from horovod_tpu.runner import run
     r0, r1 = run(_worker_chained_optimizer, np=2, env=_mp_env())
     for r in (r0, r1):
         assert r["host_blocks"] == 0, r
         assert r["host_fetches"] == 0, r
         assert r["finite"], r
+        assert r["sharded_err"] < 1e-5, r
+        # sgd momentum over a 10-element shard vs 20 params: ~half bytes
+        assert r["shard_state_bytes"] <= r["dense_state_bytes"] / 2 + 16, r
     # averaged gradients -> replicas stay in lockstep
     assert r0["w"] == r1["w"]
 
@@ -595,6 +637,38 @@ def test_sparse_optimizer_beats_dense_on_wire_bytes():
         assert r["max_err"] < 1e-6, r
         # embed leaf: dense ships V*Dm floats/step; sparse ships B*(Dm+1)
         assert r["sparse_bytes"] < r["dense_bytes"] / 5, r
+
+
+def _worker_join_np4():
+    """np=4 eager allreduce + join protocol (VERDICT r5: the cross-process
+    engine protocol was only validated at np=2): rank r runs r+1 reduction
+    rounds then joins, so every round k sees ranks {k..3} live and joined
+    ranks matching with zero substitutes."""
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+    rank, size = hvd.rank(), hvd.size()
+    sums = []
+    for k in range(rank + 1):
+        out = np.asarray(hvd.allreduce(np.ones(3) * (rank + 1),
+                                       name=f"j{k}", op=hvd.Sum))
+        sums.append(float(out[0]))
+    last = hvd.join()
+    return {"rank": rank, "sums": sums, "last": last}
+
+
+@pytest.mark.integration
+def test_four_process_allreduce_join():
+    from horovod_tpu.runner import run
+    results = run(_worker_join_np4, np=4, env=_mp_env())
+    # round k is live for ranks >= k: sum of (r+1) over r in {k..3}
+    expect = [10.0, 9.0, 7.0, 4.0]
+    for r in results:
+        assert r["sums"] == expect[:r["rank"] + 1], r
+        # rank 3 ran the most rounds, so it joins last (deterministic on
+        # every rank)
+        assert r["last"] == 3, r
 
 
 def _worker_sparse():
