@@ -797,6 +797,33 @@ def main():
     eager_disp = _engine_dispatches(
         eager_step, (params, batch_stats, eager_opt_state))
 
+    # ---- registry telemetry for one eager step (ISSUE 3 satellite) --------
+    # dispatch/wire/bucket-fill deltas from the metrics registry, so future
+    # BENCH rounds can attribute spread regressions to dispatch or fusion
+    # changes without re-deriving them from engine internals.
+    from horovod_tpu import metrics as hvd_metrics
+    _ctr = hvd_metrics.counter_total
+
+    m0 = hvd_metrics.snapshot()
+    eager_step(params, batch_stats, eager_opt_state, images, labels)
+    m1 = hvd_metrics.snapshot()
+    d_buckets = _ctr(m1, "hvd_tpu_fusion_buckets_total") \
+        - _ctr(m0, "hvd_tpu_fusion_buckets_total")
+    d_bucket_bytes = _ctr(m1, "hvd_tpu_fusion_bucket_bytes_total") \
+        - _ctr(m0, "hvd_tpu_fusion_bucket_bytes_total")
+    thr = max(eng.config.fusion_threshold_bytes, 1)
+    registry_telemetry = {
+        "dispatch_count_per_step": int(
+            _ctr(m1, "hvd_tpu_dispatches_total")
+            - _ctr(m0, "hvd_tpu_dispatches_total")),
+        "wire_bytes_per_step": int(
+            _ctr(m1, "hvd_tpu_wire_bytes_total")
+            - _ctr(m0, "hvd_tpu_wire_bytes_total")),
+        "bucket_fill_pct": (round(
+            100.0 * d_bucket_bytes / (d_buckets * thr), 2)
+            if d_buckets else None),
+    }
+
     # ---- eager path under step-capture replay -----------------------------
     # Identical step, but bracketed by step_begin/step_end: after
     # HOROVOD_TPU_STEP_REPLAY_WARMUP identical steps (inside _time_steps'
@@ -939,6 +966,7 @@ def main():
         "eager_replay_vs_spmd": round(replay_img_s / spmd_img_s, 3),
         "replay_counters": replay_counters,
         "eager_gap_attribution": gap_attribution,
+        **registry_telemetry,
         **sharded_metrics,
         "optimizer_state_bytes_per_chip": opt_state_bytes,
         "pipeline_bubble_pct": bubble.get("pipeline_bubble_pct"),

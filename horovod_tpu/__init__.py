@@ -227,6 +227,21 @@ def barrier():
     _engine().barrier()
 
 
+def metrics_snapshot() -> dict:
+    """Plain nested dict of every registered metric (counters, gauges,
+    histograms, event logs) from the process-wide registry
+    (``horovod_tpu.metrics``): wire bytes by op kind/dtype, dispatch counts,
+    fusion-bucket fill, enqueue→complete latency histograms, replay
+    arm/fallback counters, elastic membership events, autotune knobs.
+
+    Works before ``hvd.init()`` (the registry is process-wide); instruments
+    populate as subsystems run. ``HOROVOD_TPU_METRICS=0`` disables
+    collection (the snapshot is then empty). See docs/observability.md for
+    the metric names and the Prometheus ``GET /metrics`` scrape endpoint."""
+    from . import metrics as _metrics
+    return _metrics.snapshot()
+
+
 def step_heartbeat(step: Optional[int] = None):
     """SPMD-path liveness signal for the stall inspector: call once per
     (jitted) train step. When a rendezvous KV is present, rank 0 attributes
@@ -276,6 +291,7 @@ broadcast_optimizer_state = _functions.broadcast_optimizer_state
 step_begin = _functions.step_begin
 step_end = _functions.step_end
 step = _functions.step
+from . import metrics  # noqa: E402
 from . import elastic  # noqa: E402
 
 __all__ = [
@@ -285,7 +301,7 @@ __all__ = [
     "allgather", "allgather_async", "broadcast", "broadcast_async",
     "alltoall", "alltoall_async", "reducescatter", "reducescatter_async",
     "barrier", "join", "poll", "synchronize", "step_heartbeat",
-    "step_begin", "step_end", "step",
+    "step_begin", "step_end", "step", "metrics_snapshot", "metrics",
     "broadcast_parameters", "broadcast_object", "allgather_object",
     "allreduce_sparse",
     "broadcast_optimizer_state",

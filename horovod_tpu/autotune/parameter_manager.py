@@ -49,6 +49,7 @@ from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
+from ..metrics import registry as metrics_registry
 from .bayesian_optimization import BayesianOptimizer
 
 _LOG = logging.getLogger("horovod_tpu.autotune")
@@ -92,6 +93,17 @@ class ParameterManager:
         self._step_bytes = 0
         self._step_start: Optional[float] = None
         self._step_count = 0
+        # registry face (horovod_tpu/metrics.py): samples taken as a
+        # counter, current knob values as gauges
+        _reg = metrics_registry()
+        self._m_samples = _reg.counter("hvd_tpu_autotune_samples_total")
+        self._m_threshold = _reg.gauge(
+            "hvd_tpu_autotune_fusion_threshold_bytes")
+        self._m_cycle = _reg.gauge("hvd_tpu_autotune_cycle_time_ms")
+        self._m_categorical = _reg.gauge("hvd_tpu_autotune_categorical")
+        self._m_active = _reg.gauge("hvd_tpu_autotune_active")
+        self._publish_metrics()
+
         self._log_path = log_path
         self._log_file = open(log_path, "w") if log_path else None
         if self._log_file:
@@ -157,11 +169,20 @@ class ParameterManager:
         self._step_start = time.perf_counter()
         self._step_bytes = nbytes
 
+    def _publish_metrics(self):
+        self._m_threshold.set(self.fusion_threshold_bytes)
+        self._m_cycle.set(self.cycle_time_ms)
+        for c in self._categorical:
+            self._m_categorical.set(
+                1.0 if self.categorical_value(c) else 0.0, name=c)
+        self._m_active.set(1.0 if self._active else 0.0)
+
     def _on_sample(self, score: float):
         if self._warmup_remaining > 0:
             self._warmup_remaining -= 1
             return
         self._opt.register(self._current.copy(), score)
+        self._m_samples.inc()
         if self._log_file:
             cats = "".join(f",{int(self.categorical_value(c))}"
                            for c in self._categorical)
@@ -192,6 +213,7 @@ class ParameterManager:
         else:
             self._current = np.asarray(self._opt.suggest())
             self._sync_params()
+        self._publish_metrics()
 
     def _sync_params(self):
         """Agree on parameters across ranks (controller.cc:34-48): rank 0's

@@ -80,6 +80,13 @@ HOROVOD_TPU_META_CACHE_WARMUP = "HOROVOD_TPU_META_CACHE_WARMUP"
 # times, service the whole step with one fused XLA launch; =0 disables
 HOROVOD_TPU_STEP_REPLAY = "HOROVOD_TPU_STEP_REPLAY"
 HOROVOD_TPU_STEP_REPLAY_WARMUP = "HOROVOD_TPU_STEP_REPLAY_WARMUP"
+# metrics registry (horovod_tpu/metrics.py): =0 disables every instrument
+# (lock-free no-ops on the dispatch hot path); FILE enables the periodic
+# JSONL emitter; INTERVAL (seconds) paces the emitter/KV-publish/timeline-
+# counter thread
+HOROVOD_TPU_METRICS = "HOROVOD_TPU_METRICS"
+HOROVOD_TPU_METRICS_FILE = "HOROVOD_TPU_METRICS_FILE"
+HOROVOD_TPU_METRICS_INTERVAL = "HOROVOD_TPU_METRICS_INTERVAL"
 # ZeRO-1 optimizer-state sharding default for optimizers constructed with
 # sharded=None (DistributedEagerOptimizer): gradients sync via bucketed
 # reduce-scatter + shard-local update + fused allgather instead of
@@ -150,6 +157,11 @@ class Config:
     step_replay: bool = True
     step_replay_warmup: int = 3
     shard_optimizer: bool = False
+    # NOTE: the HOROVOD_TPU_METRICS on/off switch is read by
+    # metrics.metrics_enabled() (the registry outlives any Config); only
+    # the emitter knobs live here
+    metrics_file: Optional[str] = None
+    metrics_interval: float = 10.0
     extra: dict = field(default_factory=dict)
 
     @classmethod
@@ -184,4 +196,6 @@ class Config:
             step_replay=_get_bool(HOROVOD_TPU_STEP_REPLAY, True),
             step_replay_warmup=_get_int(HOROVOD_TPU_STEP_REPLAY_WARMUP, 3),
             shard_optimizer=_get_bool(HOROVOD_TPU_SHARD_OPTIMIZER, False),
+            metrics_file=os.environ.get(HOROVOD_TPU_METRICS_FILE) or None,
+            metrics_interval=_get_float(HOROVOD_TPU_METRICS_INTERVAL, 10.0),
         )

@@ -35,6 +35,7 @@ from ..common import env as env_mod
 from ..common.exceptions import DuplicateNameError, HorovodInternalError
 from ..common.lru import lru_get, lru_put, lru_touch
 from ..common.reduce_ops import ReduceOp
+from ..metrics import registry as metrics_registry
 from ..ops import collectives as C
 from ..parallel.mesh import WORLD_AXIS
 from .backend import Backend
@@ -114,11 +115,15 @@ class Handle:
     table and feed the stall inspector/timeline."""
 
     __slots__ = ("name", "_garrs", "_extract", "_engine", "_done", "_result",
-                 "_error", "_finish_lock", "enqueue_time", "recv_sizes",
-                 "_group")
+                 "_error", "_finish_lock", "enqueue_time", "_enqueue_mono",
+                 "recv_sizes", "_group", "kind")
 
     def __init__(self, name: str, garrs: List[jax.Array], extract: Callable,
-                 engine: "Engine", group: Optional[LaunchGroup] = None):
+                 engine: "Engine", group: Optional[LaunchGroup] = None,
+                 kind: Optional[str] = None):
+        # op kind for the enqueue->complete latency histogram (None skips
+        # the observation — e.g. externally-constructed handles)
+        self.kind = kind
         self.name = name
         self._garrs = garrs
         self._extract = extract
@@ -129,6 +134,9 @@ class Handle:
         self._error = None
         self._finish_lock = threading.Lock()
         self.enqueue_time = time.time()
+        # monotonic twin of enqueue_time for the latency histogram (a wall
+        # clock can step backwards and corrupt histogram sums)
+        self._enqueue_mono = time.monotonic()
         self.recv_sizes = None  # per-rank dim-0 sizes for allgather results
 
     def poll(self) -> bool:
@@ -307,6 +315,19 @@ class Engine:
         # exchanges, replay steps); the bench's dispatch-count attribution
         # of the eager-vs-SPMD gap reads deltas of this
         self.dispatch_count = 0
+        # metrics registry instruments (horovod_tpu/metrics.py). With
+        # HOROVOD_TPU_METRICS=0 every instrument is a shared lock-free
+        # no-op and _m_enabled short-circuits the bookkeeping branches, so
+        # the dispatch hot path takes no per-dispatch lock.
+        _reg = metrics_registry()
+        self._m_enabled = _reg.enabled
+        self._m_dispatches = _reg.counter("hvd_tpu_dispatches_total")
+        self._m_wire = _reg.counter("hvd_tpu_wire_bytes_total")
+        self._m_collectives = _reg.counter("hvd_tpu_collectives_total")
+        self._m_buckets = _reg.counter("hvd_tpu_fusion_buckets_total")
+        self._m_bucket_bytes = _reg.counter("hvd_tpu_fusion_bucket_bytes_total")
+        self._m_fill = _reg.gauge("hvd_tpu_fusion_bucket_fill_pct")
+        self._m_latency = _reg.histogram("hvd_tpu_op_latency_seconds")
         # elastic world identity: an elastic reset re-inits with a bumped
         # HOROVOD_TPU_WORLD_VERSION; the step-replay subsystem invalidates
         # every armed stream when this moves
@@ -376,6 +397,36 @@ class Engine:
         n = self._auto_counter.get(kind, 0)
         self._auto_counter[kind] = n + 1
         return f"{kind}.noname.{n}"
+
+    def _count_dispatch(self):
+        """One engine-issued XLA launch: the legacy counter plus the
+        registry counter (scraped as hvd_tpu_dispatches_total)."""
+        self.dispatch_count += 1
+        self._m_dispatches.inc()
+
+    def _m_account(self, kind: str, tensors):
+        """Wire-byte accounting at collective submission: payload bytes this
+        rank hands to the collective, split by op kind and dtype (the
+        reference's TensorQueue size accounting, made scrapeable). Counted
+        before replay interception — a replayed step moves the same bytes."""
+        if not self._m_enabled:
+            return
+        self._m_collectives.inc(1.0, kind=kind)
+        for t in tensors:
+            self._m_wire.inc(t.nbytes, kind=kind, dtype=str(t.dtype))
+
+    def _m_buckets_obs(self, tensors, buckets):
+        """Fusion-bucket fill efficiency for one grouped/sharded call."""
+        if not self._m_enabled or not buckets:
+            return
+        total = 0
+        for idxs in buckets:
+            b = sum(tensors[i].nbytes for i in idxs)
+            total += b
+            self._m_bucket_bytes.inc(b)
+        self._m_buckets.inc(len(buckets))
+        thr = max(self.config.fusion_threshold_bytes, 1)
+        self._m_fill.set(100.0 * total / (len(buckets) * thr))
 
     def _register(self, name: Optional[str], kind: str, nbytes: int) -> str:
         name = name or self._auto_name(kind)
@@ -478,7 +529,7 @@ class Engine:
         self._last_builder_fresh = False
         if isinstance(names, str):
             names = [names]
-        self.dispatch_count += 1
+        self._count_dispatch()
         t0 = time.perf_counter()
         try:
             return _translate_failure(fn, *args)
@@ -760,14 +811,18 @@ class Engine:
     def _on_complete(self, h: Handle):
         with self._lock:
             self._outstanding.pop(h.name, None)
+        if self._m_enabled and h.kind is not None:
+            self._m_latency.observe(time.monotonic() - h._enqueue_mono,
+                                    kind=h.kind)
         if self.on_done is not None:
             self.on_done(h.name)
 
     def _single(self, name: str, garr: jax.Array,
-                replicated: bool = True) -> Handle:
+                replicated: bool = True,
+                kind: Optional[str] = None) -> Handle:
         extract = (self.backend.from_replicated if replicated
                    else self.backend.from_global)
-        h = Handle(name, [garr], lambda gs: extract(gs[0]), self)
+        h = Handle(name, [garr], lambda gs: extract(gs[0]), self, kind=kind)
         self._track(name, h)
         return h
 
@@ -819,6 +874,7 @@ class Engine:
         x = jnp.asarray(tensor)
         sub = self._consume_substitute()
         _check_average_dtype(x, op)
+        self._m_account("allreduce", [x])
         r = self._replay.intercept("allreduce", [x], int(op),
                                    prescale_factor, postscale_factor, name,
                                    sub)
@@ -830,7 +886,7 @@ class Engine:
                           wildcard=sub)
         fn = self._allreduce_builder(op, prescale_factor, postscale_factor)
         out = self._dispatch(name, lambda: fn(self.backend.to_global(x)))
-        return self._single(name, out)
+        return self._single(name, out, kind="allreduce")
 
     def grouped_allreduce(self, tensors: Sequence, name: Optional[str] = None,
                           op: ReduceOp = ReduceOp.SUM,
@@ -844,6 +900,7 @@ class Engine:
         for t in tensors:
             _check_average_dtype(t, op)
         if tensors:
+            self._m_account("grouped_allreduce", tensors)
             r = self._replay.intercept("grouped_allreduce", tensors, int(op),
                                        prescale_factor, postscale_factor,
                                        name, sub)
@@ -861,6 +918,7 @@ class Engine:
         if not tensors:
             return []
         buckets = bucket_by_size(tensors, self.config.fusion_threshold_bytes)
+        self._m_buckets_obs(tensors, buckets)
         mesh = self.backend.group_mesh
         hier_local = (self.backend.local_size()
                       if (self.config.hierarchical_allreduce and
@@ -886,7 +944,7 @@ class Engine:
             pack_fn = self._builder(
                 ("pack_group", shapes, dtypes, bkey),
                 lambda: C.build_pack_group(buckets))
-            self.dispatch_count += 1
+            self._count_dispatch()
             packed = _translate_failure(pack_fn, *tensors)
             fn = self._builder(
                 ("grouped_allreduce", op, prescale_factor,
@@ -910,7 +968,7 @@ class Engine:
                 bucket = [tensors[i] for i in idxs]
                 shapes = tuple(tuple(t.shape) for t in bucket)
                 dtype = bucket[0].dtype
-                self.dispatch_count += 1
+                self._count_dispatch()
                 if use_pallas_pack:
                     packed = _translate_failure(pack_pallas, bucket)
                 else:
@@ -935,7 +993,7 @@ class Engine:
             garr, group = results[i]
             h = Handle(nm, [garr],
                        lambda gs: self.backend.from_replicated(gs[0]), self,
-                       group=group)
+                       group=group, kind="grouped_allreduce")
             self._track(nm, h)
             handles.append(h)
         return handles
@@ -977,6 +1035,16 @@ class Engine:
             buckets = bucket_by_size(tensors,
                                      self.config.fusion_threshold_bytes)
         bkey = tuple(tuple(b) for b in buckets)
+        # wire accounting: a sharded step moves each gradient bucket once
+        # as a reduce-scatter and once back as the parameter all-gather
+        if self._m_enabled:
+            self._m_collectives.inc(1.0, kind="sharded_step")
+            for t in tensors:
+                self._m_wire.inc(t.nbytes, kind="reducescatter",
+                                 dtype=str(t.dtype))
+                self._m_wire.inc(t.nbytes, kind="allgather",
+                                 dtype=str(t.dtype))
+        self._m_buckets_obs(tensors, buckets)
         # register BEFORE replay interception: a replayed launch resolves
         # the update closure from this registry at trace time. LRU-bounded
         # like the builder cache (an armed program only reads the registry
@@ -1006,7 +1074,7 @@ class Engine:
         st_dtypes = tuple(str(s.dtype) for s in state_leaves)
         pack_fn = self._builder(("pack_group", shapes, dtypes, bkey),
                                 lambda: C.build_pack_group(buckets))
-        self.dispatch_count += 1
+        self._count_dispatch()
         packed = _translate_failure(pack_fn, *tensors)
         fn = self._builder(
             ("sharded_step", op, prescale_factor, postscale_factor,
@@ -1026,7 +1094,7 @@ class Engine:
         for i, nm in enumerate(names):
             h = Handle(nm, [outs[i]],
                        lambda gs: self.backend.from_replicated(gs[0]), self,
-                       group=group)
+                       group=group, kind="sharded_step")
             self._track(nm, h)
             handles.append(h)
         return handles
@@ -1051,6 +1119,7 @@ class Engine:
         hot peers' deferred check still sees an unchanged world)."""
         x = jnp.asarray(tensor)
         sub = self._consume_substitute()
+        self._m_account("allgather", [x])
         self._replay.observe("allgather", sub, [x], name)
         name = self._register(name, "allgather", x.nbytes)
         key_hash = _sub_hash if _sub_hash is not None else \
@@ -1113,7 +1182,7 @@ class Engine:
                      for r in range(size)]
             return jnp.concatenate(parts, axis=0)
 
-        h = Handle(name, [out], extract, self)
+        h = Handle(name, [out], extract, self, kind="allgather")
         h.recv_sizes = np.asarray(sizes)
         self._track(name, h)
         return h
@@ -1121,6 +1190,7 @@ class Engine:
     def broadcast(self, tensor, root_rank: int, name: Optional[str] = None) -> Handle:
         x = jnp.asarray(tensor)
         sub = self._consume_substitute()
+        self._m_account("broadcast", [x])
         r = self._replay.intercept("broadcast", [x], root_rank, 1.0, 1.0,
                                    name, sub)
         if r is not None:
@@ -1135,7 +1205,7 @@ class Engine:
                 ("broadcast", root_rank),
                 lambda: C.build_broadcast(mesh, self._axis(), root_rank))
             out = self._dispatch(name, lambda: fn(self.backend.to_global(x)))
-            return self._single(name, out)
+            return self._single(name, out, kind="broadcast")
         # Join-enabled worlds carry the root's active bit in the same launch
         # (build_broadcast_flagged): a join substitute from a joined root
         # sends active=0, and extract raises instead of returning zeros —
@@ -1158,7 +1228,7 @@ class Engine:
                     f"and has no data to broadcast")
             return self.backend.from_replicated(data)
 
-        h = Handle(name, [out, flag], extract, self)
+        h = Handle(name, [out, flag], extract, self, kind="broadcast")
         self._track(name, h)
         return h
 
@@ -1174,6 +1244,7 @@ class Engine:
         sub = self._consume_substitute()
         if not tensors:
             return []
+        self._m_account("grouped_broadcast", tensors)
         r = self._replay.intercept("grouped_broadcast", tensors, root_rank,
                                    1.0, 1.0, name, sub)
         if r is not None:
@@ -1190,8 +1261,10 @@ class Engine:
         check_join = self.config.join_enabled and self.backend.size() > 1
         active = np.zeros((1,), np.int32) if sub else np.ones((1,), np.int32)
         results: Dict[int, tuple] = {}
-        for idxs in bucket_by_size(tensors,
-                                   self.config.fusion_threshold_bytes):
+        bc_buckets = bucket_by_size(tensors,
+                                    self.config.fusion_threshold_bytes)
+        self._m_buckets_obs(tensors, bc_buckets)
+        for idxs in bc_buckets:
             bucket = [tensors[i] for i in idxs]
             shapes = tuple(tuple(t.shape) for t in bucket)
             dtype = bucket[0].dtype
@@ -1228,7 +1301,8 @@ class Engine:
                         f"joined and has no data to broadcast")
                 return self.backend.from_replicated(gs[0])
 
-            h = Handle(nm, [garr], extract, self, group=group)
+            h = Handle(nm, [garr], extract, self, group=group,
+                       kind="grouped_broadcast")
             self._track(nm, h)
             handles.append(h)
         return handles
@@ -1241,6 +1315,7 @@ class Engine:
         :meth:`allgather` — the join-substitute replay path."""
         x = jnp.asarray(tensor)
         sub = self._consume_substitute()
+        self._m_account("alltoall", [x])
         self._replay.observe("alltoall", sub, [x], name)
         name = self._register(name, "alltoall", x.nbytes)
         key_hash = _sub_hash if _sub_hash is not None else \
@@ -1300,7 +1375,7 @@ class Engine:
                      for r in range(size)]
             return jnp.concatenate(parts, axis=0), jnp.asarray(recv_splits)
 
-        h = Handle(name, [out], extract, self)
+        h = Handle(name, [out], extract, self, kind="alltoall")
         self._track(name, h)
         return h
 
@@ -1311,6 +1386,7 @@ class Engine:
         x = jnp.asarray(tensor)
         sub = self._consume_substitute()
         _check_average_dtype(x, op)
+        self._m_account("reducescatter", [x])
         self._replay.observe("reducescatter", sub, [x], name)
         name = self._register(name, "reducescatter", x.nbytes)
         self._join_sync("reducescatter", [_join_meta_row(x, int(op))],
@@ -1335,7 +1411,8 @@ class Engine:
                                                          op, pad_rows=pad))
         out = self._dispatch(name, lambda: fn(self.backend.to_global(x)))
         if not pad:
-            return self._single(name, out, replicated=False)
+            return self._single(name, out, replicated=False,
+                                kind="reducescatter")
         rank = self.backend.rank()
         keep = min(chunk, max(d0 - rank * chunk, 0))
 
@@ -1343,7 +1420,7 @@ class Engine:
             shard = self.backend.from_global(gs[0])  # (chunk, *s) padded
             return shard if keep == chunk else shard[:keep]
 
-        h = Handle(name, [out], extract, self)
+        h = Handle(name, [out], extract, self, kind="reducescatter")
         h.recv_sizes = np.array(
             [min(chunk, max(d0 - r * chunk, 0)) for r in range(size)])
         self._track(name, h)
@@ -1351,11 +1428,12 @@ class Engine:
 
     def barrier(self):
         sub = self._consume_substitute()
+        self._m_account("barrier", [])
         self._replay.observe("barrier", sub)
         self._join_sync("barrier", [], skip=sub)
         mesh = self.backend.group_mesh
         fn = self._builder(("barrier",), lambda: C.build_barrier(mesh, self._axis()))
-        self.dispatch_count += 1
+        self._count_dispatch()
         out = _translate_failure(
             lambda: fn(self.backend.to_global(jnp.zeros((), jnp.int32))))
         _translate_failure(out.block_until_ready)
@@ -1369,7 +1447,7 @@ class Engine:
         mesh = self.backend.group_mesh
         fn = self._builder(("allgather",),
                            lambda: C.build_allgather(mesh, self._axis()))
-        self.dispatch_count += 1
+        self._count_dispatch()
         return _translate_failure(
             lambda: fn(self.backend.to_global(jnp.asarray(local_vec))))
 

@@ -45,6 +45,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..common.lru import lru_get, lru_put
+from ..metrics import registry as metrics_registry
 
 # step counters in tensor names ("grad.s17", "bench.grad.42") must not make
 # otherwise-identical steps look distinct — normalize digit runs away
@@ -187,6 +188,16 @@ class StepReplay:
         self.replayed_steps = 0
         self.captured_streams = 0
         self.fallbacks = 0
+        # registry instruments (horovod_tpu/metrics.py): the scrapeable
+        # face of the same lifecycle — arm/replay/fallback/invalidate plus
+        # the step counter the replayed-vs-eager ratio derives from
+        _reg = metrics_registry()
+        self._m_steps = _reg.counter("hvd_tpu_steps_total")
+        self._m_armed = _reg.counter("hvd_tpu_replay_armed_total")
+        self._m_replayed = _reg.counter("hvd_tpu_replay_replayed_steps_total")
+        self._m_fallbacks = _reg.counter("hvd_tpu_replay_fallbacks_total")
+        self._m_invalidations = _reg.counter(
+            "hvd_tpu_replay_invalidations_total")
 
     # -- step lifecycle ----------------------------------------------------
 
@@ -225,6 +236,7 @@ class StepReplay:
     def step_end(self):
         if not self._in_step:
             return
+        self._m_steps.inc()
         try:
             if self._mode == "replay" and self._pos > 0 and not self._launched:
                 complete = [s for s in self._cands if len(s) == self._pos]
@@ -255,6 +267,7 @@ class StepReplay:
             ent["armed"] = self._build_armed(stream)
             if ent["armed"] is not None:
                 self.captured_streams += 1
+                self._m_armed.inc()
                 self.engine._emit_replay(
                     "capture",
                     f"armed after {ent['streak']} identical steps: "
@@ -272,6 +285,7 @@ class StepReplay:
             self._mode = "record" if self._in_step else "idle"
             self._cands = []
         if had_armed:
+            self._m_invalidations.inc()
             self.engine._emit_replay("invalidate", reason)
 
     # -- per-call interception --------------------------------------------
@@ -474,6 +488,9 @@ class StepReplay:
 
     def _fallback(self, reason: str):
         self.fallbacks += 1
+        # digit-normalized reason keeps the label set bounded ("divergence
+        # at op 3" and "at op 7" are one series)
+        self._m_fallbacks.inc(reason=_DIGITS.sub("#", reason))
         eng = self.engine
         if eng.replay_fallback_counter is not None:
             eng.replay_fallback_counter(reason)
@@ -520,7 +537,7 @@ class StepReplay:
         t0 = time.perf_counter()
         outs = engine_mod._translate_failure(
             lambda: fn(*[eng.backend.world_view(t) for t in flat]))
-        eng.dispatch_count += 1
+        eng._count_dispatch()
         if eng.on_activity is not None:
             eng.on_activity(rep_name, "XLA_REPLAY_DISPATCH",
                             (time.perf_counter() - t0) * 1e6)
@@ -535,10 +552,11 @@ class StepReplay:
         # ONE tracked representative per replayed step: retires through the
         # cycle loop, feeds the stall inspector and timeline done events
         rep = engine_mod.Handle(rep_name, [outs[-1]], lambda gs: None, eng,
-                                group=group)
+                                group=group, kind="replay")
         eng._track(rep_name, rep)
         self._launched = True
         if not padded:
             self.replayed_steps += 1
+            self._m_replayed.inc()
             eng._emit_replay(
                 "replay", f"{len(flat)} tensors in 1 launch ({rep_name})")

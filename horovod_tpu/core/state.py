@@ -21,6 +21,7 @@ class GlobalState:
         self.timeline = None
         self.stall_inspector = None
         self.parameter_manager = None
+        self.metrics_emitter = None
 
     def init(self):
         with self._lock:
@@ -34,24 +35,36 @@ class GlobalState:
             self._wire_observability()
 
     def _wire_observability(self):
+        import os
         cfg = self.config
+        kv = None
+        rdv_addr = os.environ.get(env_mod.HOROVOD_GLOO_RENDEZVOUS_ADDR)
+        rdv_port = os.environ.get(env_mod.HOROVOD_GLOO_RENDEZVOUS_PORT)
+        if rdv_addr and rdv_port:
+            kv = (rdv_addr, int(rdv_port))
         if cfg.timeline_path and self.backend.rank() == 0:
             from ..timeline import Timeline
             self.timeline = Timeline(cfg.timeline_path,
                                      mark_cycles=cfg.timeline_mark_cycles)
             self.timeline.start()
         if not cfg.stall_check_disable:
-            import os
             from ..stall_inspector import StallInspector
-            kv = None
-            rdv_addr = os.environ.get(env_mod.HOROVOD_GLOO_RENDEZVOUS_ADDR)
-            rdv_port = os.environ.get(env_mod.HOROVOD_GLOO_RENDEZVOUS_PORT)
-            if rdv_addr and rdv_port:
-                kv = (rdv_addr, int(rdv_port))
             self.stall_inspector = StallInspector(
                 warning_seconds=cfg.stall_warning_seconds,
                 shutdown_seconds=cfg.stall_shutdown_seconds,
                 kv=kv, rank=self.backend.rank(), size=self.backend.size())
+        # metrics emitter (horovod_tpu/metrics.py): one thread, three sinks
+        # — JSONL file, rendezvous-KV publish (feeds the cluster-aggregated
+        # GET /metrics on the runner server), Chrome-trace counter tracks
+        from ..metrics import MetricsEmitter, registry as metrics_registry
+        reg = metrics_registry()
+        if reg.enabled and (cfg.metrics_file or kv is not None
+                            or self.timeline is not None):
+            self.metrics_emitter = MetricsEmitter(
+                reg, interval=cfg.metrics_interval,
+                jsonl_path=cfg.metrics_file, kv=kv,
+                rank=self.backend.rank(), timeline=self.timeline)
+            self.metrics_emitter.start()
 
         if cfg.autotune:
             from ..autotune.parameter_manager import ParameterManager
@@ -146,6 +159,11 @@ class GlobalState:
         with self._lock:
             if self.engine is not None:
                 self.engine.stop()
+            if self.metrics_emitter is not None:
+                # final flush: short-lived jobs still leave a JSONL record
+                # and a last KV publish for the scrape endpoint
+                self.metrics_emitter.stop(final_flush=True)
+                self.metrics_emitter = None
             if self.timeline is not None:
                 self.timeline.stop()
                 self.timeline = None

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..metrics import registry as metrics_registry
 from ..runner.hosts import SlotInfo, get_host_assignments
 from .discovery import HostDiscovery, HostManager, HostUpdateResult
 from .registration import WorkerStateRegistry
@@ -61,6 +62,12 @@ class ElasticDriver:
         self._world_version = 0
         self._pending_resume = False
         self._results: Dict[str, Tuple[object, int]] = {}
+
+        # membership telemetry (horovod_tpu/metrics.py): the world version
+        # as a gauge and rank join/leave/blacklist as a monotonic event log
+        _reg = metrics_registry()
+        self._m_world_version = _reg.gauge("hvd_tpu_elastic_world_version")
+        self._m_events = _reg.event_log("hvd_tpu_elastic_events")
 
         self._lock = threading.RLock()
         self._shutdown = threading.Event()
@@ -214,6 +221,11 @@ class ElasticDriver:
                 self._results.pop(f"{s.hostname}:{s.local_rank}", None)
             _LOG.info("world v%d: %d workers (%d newly started)",
                       self._world_version, len(assignments), len(pending))
+            self._m_world_version.set(self._world_version)
+            self._m_events.append(
+                "world_activated",
+                f"v{self._world_version} workers={len(assignments)} "
+                f"started={len(pending)}")
         for s in pending:
             self._create_worker_fn(s)
 
@@ -308,6 +320,7 @@ class ElasticDriver:
     # -- worker events (called by rendezvous handler / process monitors) ----
 
     def record_ready(self, host: str, local_rank: int):
+        self._m_events.append("rank_join", f"{host}:{local_rank}")
         self._registry.record_ready(host, local_rank)
 
     def record_worker_exit(self, host: str, local_rank: int, exit_code: int,
@@ -315,6 +328,7 @@ class ElasticDriver:
         """Called by the launcher's process monitor on worker termination."""
         key = f"{host}:{local_rank}"
         self._results[key] = (result, exit_code)
+        self._m_events.append("rank_leave", f"{key} exit={exit_code}")
         if exit_code == 0:
             with self._lock:
                 # the process is gone either way; a future resume that
@@ -347,6 +361,7 @@ class ElasticDriver:
             # is permanently excluded (reference driver.py:136-139).
             if not self._host_still_alive(host):
                 self._host_manager.blacklist(host)
+                self._m_events.append("blacklist", host)
             self._registry.record_failure(host, local_rank)
 
     def _host_still_alive(self, host: str) -> bool:

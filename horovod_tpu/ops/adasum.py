@@ -163,6 +163,7 @@ def adasum_allreduce_handle(engine, tensor, name=None, prescale_factor=1.0,
     """Engine entry point for op=Adasum on the eager path."""
     x = jnp.asarray(tensor)
     sub = engine._consume_substitute()
+    engine._m_account("adasum", [x])
     # Adasum's per-tensor coefficient recursion cannot ride the packed
     # replay program — mark the step unreplayable (core/replay.py).
     engine._replay.observe("adasum", sub, [x], name)
@@ -187,8 +188,9 @@ def adasum_allreduce_handle(engine, tensor, name=None, prescale_factor=1.0,
                                               postscale_factor,
                                               local_size=local))
     from ..core.engine import _translate_failure
+    engine._count_dispatch()
     out = _translate_failure(lambda: fn(engine.backend.to_global(x)))
-    return engine._single(name, out)
+    return engine._single(name, out, kind="adasum")
 
 
 def adasum_reference(vectors):

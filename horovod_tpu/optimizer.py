@@ -26,6 +26,7 @@ Both support op=Average|Sum|Adasum, gradient compression
 
 from __future__ import annotations
 
+import time as _time
 from typing import Any, NamedTuple, Optional
 
 import jax
@@ -35,6 +36,7 @@ import optax
 from .common import env as _env
 from .common.lru import lru_get, lru_put
 from .common.reduce_ops import ReduceOp, Average, Sum, Adasum
+from .metrics import registry as _metrics_registry
 from .ops import collectives as C
 from .ops.adasum import adasum_p
 from .ops.compression import Compression
@@ -459,6 +461,8 @@ class DistributedEagerOptimizer:
         self._ks_cache = {}
         self._layout_cache = {}   # frozen ZeRO-1 bucket layouts per tree
         self._cache_cap = 16
+        self._m_sharded_step = _metrics_registry().histogram(
+            "hvd_tpu_sharded_step_seconds")
 
     def _is_sharded(self) -> bool:
         if self._sharded is None:
@@ -559,6 +563,7 @@ class DistributedEagerOptimizer:
             return new_shards, jax.tree_util.tree_leaves(new_state)
 
         update_key = ("zero1", self._zero1_token, treedef, state_treedef)
+        t0 = _time.perf_counter()
         eng.step_begin()
         try:
             # the FROZEN layout's buckets ride along so a live fusion-
@@ -570,6 +575,10 @@ class DistributedEagerOptimizer:
                 buckets=[list(idxs) for idxs, _, _, _ in layout])
         finally:
             eng.step_end()
+        # dispatch-phase wall time (pack + the fused rs->update->ag launch;
+        # the collective itself completes asynchronously and is covered by
+        # hvd_tpu_op_latency_seconds{kind="sharded_step"})
+        self._m_sharded_step.observe(_time.perf_counter() - t0)
         self._step = (self._step + 1) % 1024
         n = len(leaves)
         new_params = jax.tree_util.tree_unflatten(

@@ -14,6 +14,7 @@ registration (reference elastic/rendezvous.py:37-55).
 from __future__ import annotations
 
 import collections
+import json
 import logging
 import socket
 import threading
@@ -25,6 +26,12 @@ _LOG = logging.getLogger("horovod_tpu.runner")
 OK = 200
 NOT_FOUND = 404
 BAD_REQUEST = 400
+
+# Prometheus exposition content type (text format 0.0.4)
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+# KV scope workers publish snapshots under (== metrics.METRICS_KV_SCOPE;
+# kept literal so the server module stays importable standalone)
+METRICS_SCOPE = "metrics"
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -49,6 +56,8 @@ class _KVHandler(BaseHTTPRequestHandler):
             self.end_headers()
             return
         self.send_response(OK)
+        if scope == METRICS_SCOPE and not key:
+            self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
         self.send_header("Content-Length", str(len(value)))
         self.end_headers()
         self.wfile.write(value)
@@ -64,7 +73,12 @@ class _KVHandler(BaseHTTPRequestHandler):
 
 
 class KVStoreServer(ThreadingHTTPServer):
-    """Plain scoped KV store over HTTP (reference http_server.py:175-242)."""
+    """Plain scoped KV store over HTTP (reference http_server.py:175-242).
+
+    Additionally answers ``GET /metrics`` (scope ``metrics``, empty key)
+    with a Prometheus-text cluster aggregation of every worker snapshot
+    published under ``metrics/<rank>`` — the scrape endpoint of
+    ``horovod_tpu.metrics`` (each series carries a ``rank`` label)."""
 
     daemon_threads = True
 
@@ -77,8 +91,31 @@ class KVStoreServer(ThreadingHTTPServer):
     # -- handler callbacks --------------------------------------------------
 
     def handle_get(self, scope: str, key: str, handler) -> Optional[bytes]:
+        if scope == METRICS_SCOPE and not key:
+            return self._render_metrics()
         with self._lock:
             return self._store.get(scope, {}).get(key)
+
+    def _render_metrics(self) -> bytes:
+        from ..metrics import registry, render_prometheus_cluster
+        with self._lock:
+            payloads = dict(self._store.get(METRICS_SCOPE, {}))
+        snaps = {}
+        for rank, raw in payloads.items():
+            try:
+                snaps[rank] = json.loads(raw)
+            except Exception:
+                _LOG.debug("unparseable metrics payload from rank %s", rank)
+        # The server runs in the launcher/driver process, whose own registry
+        # (elastic world version + membership event log) has no KV publish
+        # path — merge it into the scrape under rank="driver" so elastic
+        # telemetry is visible without a worker-side hop.
+        local = registry().snapshot()
+        if local.get("enabled") and any(
+                local.get(s) for s in ("counters", "gauges", "histograms",
+                                       "events")):
+            snaps.setdefault("driver", local)
+        return render_prometheus_cluster(snaps).encode()
 
     def handle_put(self, scope: str, key: str, value: bytes, handler) -> int:
         with self._lock:

@@ -24,9 +24,15 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from .metrics import registry as metrics_registry
+
 logger = logging.getLogger("horovod_tpu")
 
 KV_SCOPE = "stall"
+
+# consecutive publish failures before the first WARNING; later warnings
+# back off exponentially (2x the streak each time) instead of per-tick spam
+PUBLISH_FAIL_WARN_AFTER = 3
 
 
 class StallInspector:
@@ -48,6 +54,16 @@ class StallInspector:
         # attributing, so the count rides the cross-rank liveness report
         self.replay_fallbacks = 0
         self._replay_reasons: Dict[str, int] = {}
+        # KV publish health (ISSUE 3 satellite): failures were swallowed at
+        # debug level, so a dead rendezvous left the cross-rank attribution
+        # silently blind. Track the consecutive-failure streak; escalate to
+        # WARNING with exponential backoff and count into the registry.
+        self._pub_fail_streak = 0
+        self._pub_fail_warn_at = PUBLISH_FAIL_WARN_AFTER
+        _reg = metrics_registry()
+        self._m_pub_failures = _reg.counter(
+            "hvd_tpu_stall_publish_failures_total")
+        self._m_stalled = _reg.gauge("hvd_tpu_stall_stalled_tensors")
         self._heartbeat_step = -1
         self._heartbeat_time = time.time()
         self._cross_warned: set = set()
@@ -119,7 +135,20 @@ class StallInspector:
                                   str(self.rank),
                                   json.dumps(payload).encode(), timeout=5)
         except Exception as e:
-            logger.debug("stall publish failed: %s", e)
+            self._pub_fail_streak += 1
+            self._m_pub_failures.inc()
+            if self._pub_fail_streak >= self._pub_fail_warn_at:
+                logger.warning(
+                    "stall-inspector KV publish to %s:%s has failed %d "
+                    "consecutive times (last: %s); cross-rank stall "
+                    "attribution is blind until it recovers.",
+                    self.kv[0], self.kv[1], self._pub_fail_streak, e)
+                self._pub_fail_warn_at *= 2   # backoff, not per-tick spam
+            else:
+                logger.debug("stall publish failed: %s", e)
+        else:
+            self._pub_fail_streak = 0
+            self._pub_fail_warn_at = PUBLISH_FAIL_WARN_AFTER
 
     def _aggregate(self):
         """Rank 0: read every rank's report; attribute stalls to ranks
@@ -185,6 +214,8 @@ class StallInspector:
             now = time.monotonic()
             with self._lock:
                 items = list(self._outstanding.items())
+            self._m_stalled.set(sum(
+                1 for _, t0 in items if now - t0 > self.warning_seconds))
             for name, t0 in items:
                 age = now - t0
                 if age > self.warning_seconds and name not in self._warned:

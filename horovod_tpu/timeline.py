@@ -26,6 +26,11 @@ from typing import Optional
 
 _AUTO_NAME_RE = re.compile(r"\.noname\.\d+$")
 _MAX_TIDS = 4096
+# Past _MAX_TIDS distinct names, new names hash onto this reserved tid pool
+# (tids _MAX_TIDS+1 .. _MAX_TIDS+_OVERFLOW_TIDS). Deterministic per name, so
+# a tensor's B/E events stay balanced on one track — where the old collapse
+# onto tid 0 interleaved every overflow tensor's spans on a single row.
+_OVERFLOW_TIDS = 64
 
 
 def _native_enabled() -> bool:
@@ -98,7 +103,12 @@ class Timeline:
         tid = self._tids.get(key)
         if tid is None:
             if len(self._tids) >= _MAX_TIDS:
-                return 0
+                # map is full: stable hash onto the reserved overflow pool
+                # (not cached — the map must stop growing). Collisions share
+                # a track, but one name's B/E pairs never split across tids.
+                import zlib
+                return _MAX_TIDS + 1 + (zlib.crc32(key.encode())
+                                        % _OVERFLOW_TIDS)
             tid = self._next_tid
             self._next_tid += 1
             self._tids[key] = tid
@@ -147,6 +157,19 @@ class Timeline:
         if detail:
             ev["args"] = {"detail": detail}
         self._q.put(ev)
+
+    def record_counter(self, name: str, values: dict):
+        """Chrome-trace counter track (``ph:"C"``): ``values`` maps series
+        name -> number and renders as a stacked counter row riding the same
+        trace as the spans. The MetricsEmitter samples wire-byte and
+        dispatch rates from the metrics registry through this."""
+        if self._native is not None:
+            self._native.hvd_timeline_event(
+                b"C", name.encode(), int(self._ts_us()), 0, 0,
+                json.dumps(values).encode())
+            return
+        self._q.put({"name": name, "ph": "C", "ts": self._ts_us(),
+                     "pid": 0, "tid": 0, "args": dict(values)})
 
     def mark_cycle(self):
         if not self.mark_cycles:

@@ -55,6 +55,31 @@ def test_cross_rank_missing_tensor_attribution(kv_server, caplog):
                for m in msgs), msgs
 
 
+def test_publish_failure_escalates_to_warning(caplog):
+    """ISSUE 3 satellite: KV publish failures were swallowed at debug level;
+    after PUBLISH_FAIL_WARN_AFTER consecutive failures the inspector must
+    emit a WARNING (with backoff — far fewer warnings than failures) and
+    count into the registry's hvd_tpu_stall_publish_failures_total."""
+    from horovod_tpu.metrics import registry
+    from horovod_tpu.runner.http_server import find_free_port
+    ctr = registry().counter("hvd_tpu_stall_publish_failures_total")
+    before = ctr.total()
+    # a freshly-probed free port with no listener: every publish fails fast
+    insp = StallInspector(warning_seconds=0.1, check_interval=0.05,
+                          kv=("127.0.0.1", find_free_port()), rank=1, size=2)
+    with caplog.at_level(logging.DEBUG, logger="horovod_tpu"):
+        time.sleep(1.2)
+    insp.stop()
+    failures = ctr.total() - before
+    assert failures >= 3, failures
+    warns = [r for r in caplog.records
+             if r.levelno == logging.WARNING
+             and "attribution is blind" in r.getMessage()]
+    assert warns, "no escalation warning"
+    # backoff: warnings fire at streaks 3, 6, 12, ... — not per tick
+    assert len(warns) < failures / 2 + 1, (len(warns), failures)
+
+
 def test_cross_rank_heartbeat_attribution(kv_server, caplog):
     """Rank 1's step heartbeat stops advancing while rank 0's continues:
     rank 0 reports the hung rank (SPMD-path coverage)."""

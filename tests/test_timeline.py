@@ -11,13 +11,15 @@ import os
 import pytest
 
 from horovod_tpu import native
-from horovod_tpu.timeline import Timeline
+from horovod_tpu.timeline import _MAX_TIDS, _OVERFLOW_TIDS, Timeline
 
 
 def _exercise(tl: Timeline):
     tl.record_enqueue("grad.0", "allreduce", 4096)
     tl.record_activity("grad.0", "XLA_ALLREDUCE", 120.0)
     tl.record_done("grad.0")
+    tl.record_counter("hvd_tpu_wire_bytes_per_sec",
+                      {"bytes_per_sec": 123.5})
     tl.mark_cycle()
     tl.stop()
 
@@ -43,6 +45,9 @@ def test_python_writer(tmp_path, monkeypatch):
     assert b["name"] == "ALLREDUCE"
     assert b["args"]["tensor"] == "grad.0"
     assert b["args"]["bytes"] == 4096
+    c = next(e for e in events if e["ph"] == "C")
+    assert c["name"] == "hvd_tpu_wire_bytes_per_sec"
+    assert c["args"]["bytes_per_sec"] == 123.5
 
 
 def test_native_writer(tmp_path, monkeypatch):
@@ -62,6 +67,37 @@ def test_native_writer(tmp_path, monkeypatch):
     assert b["args"]["tensor"] == "grad.0"
     x = next(e for e in events if e["ph"] == "X")
     assert x["dur"] == 120
+    c = next(e for e in events if e["ph"] == "C")
+    assert c["name"] == "hvd_tpu_wire_bytes_per_sec"
+    assert c["args"]["bytes_per_sec"] == 123.5
+
+
+def test_tid_overflow_hashes_onto_reserved_pool(tmp_path, monkeypatch):
+    """ISSUE 3 satellite: past _MAX_TIDS distinct names, new names must hash
+    onto the reserved overflow tid pool (stable per name) instead of
+    collapsing onto tid 0 — a >4096-name trace still parses with balanced
+    B/E per tid."""
+    monkeypatch.setenv("HOROVOD_TIMELINE_NATIVE", "0")
+    p = str(tmp_path / "big.json")
+    tl = Timeline(p)
+    tl.start()
+    n = _MAX_TIDS + 300
+    for i in range(n):
+        tl.record_enqueue(f"tensor.{i}", "allreduce", 1)
+        tl.record_done(f"tensor.{i}")
+    tl.stop()
+    events = _load_events(p)
+    assert len(events) == 2 * n          # the full trace parsed
+    per_tid = {}
+    for e in events:
+        per_tid.setdefault(e["tid"], []).append(e["ph"])
+    for tid, phases in per_tid.items():
+        assert phases.count("B") == phases.count("E"), tid
+    overflow = [t for t in per_tid if t > _MAX_TIDS]
+    assert overflow, "no overflow tids recorded"
+    assert all(t <= _MAX_TIDS + _OVERFLOW_TIDS for t in overflow)
+    # nothing fell onto tid 0 (the old corruption mode)
+    assert 0 not in per_tid
 
 
 def test_native_build_and_introspection():
