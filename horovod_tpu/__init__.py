@@ -292,6 +292,7 @@ step_begin = _functions.step_begin
 step_end = _functions.step_end
 step = _functions.step
 from . import metrics  # noqa: E402
+from . import faults  # noqa: E402
 from . import elastic  # noqa: E402
 
 __all__ = [
@@ -302,6 +303,7 @@ __all__ = [
     "alltoall", "alltoall_async", "reducescatter", "reducescatter_async",
     "barrier", "join", "poll", "synchronize", "step_heartbeat",
     "step_begin", "step_end", "step", "metrics_snapshot", "metrics",
+    "faults",
     "broadcast_parameters", "broadcast_object", "allgather_object",
     "allreduce_sparse",
     "broadcast_optimizer_state",

@@ -93,6 +93,21 @@ HOROVOD_TPU_METRICS_INTERVAL = "HOROVOD_TPU_METRICS_INTERVAL"
 # allreduce + replicated update (docs/sharded_optimizer.md). Also offered
 # as an autotune categorical; resolved once per optimizer at state init.
 HOROVOD_TPU_SHARD_OPTIMIZER = "HOROVOD_TPU_SHARD_OPTIMIZER"
+# fault injection (horovod_tpu/faults.py, which imports this constant):
+# a failpoint spec string; unset means every failpoint() marker is a
+# no-op. Parsed by faults._arm_from_env at import.
+HOROVOD_TPU_FAULTS = "HOROVOD_TPU_FAULTS"
+# collective watchdog (stall_inspector.py): seconds a collective may sit
+# outstanding — or a peer heartbeat may lag — before the inspector aborts
+# local collectives and raises HorovodInternalError so the elastic
+# run-loop can recover. 0 (default) disables the watchdog; the warning
+# thresholds alone then apply, preserving the legacy hang-forever behavior.
+HOROVOD_TPU_COLLECTIVE_DEADLINE = "HOROVOD_TPU_COLLECTIVE_DEADLINE"
+# elastic driver slot-failure backoff (elastic/driver.py): base seconds a
+# repeatedly-failing slot is suspended before re-admission (doubles per
+# strike); slots past HOROVOD_ELASTIC_SLOT_FAILURE_LIMIT are out for good
+HOROVOD_ELASTIC_FAILURE_BACKOFF = "HOROVOD_ELASTIC_FAILURE_BACKOFF"
+HOROVOD_ELASTIC_SLOT_FAILURE_LIMIT = "HOROVOD_ELASTIC_SLOT_FAILURE_LIMIT"
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # operations.cc:432
 DEFAULT_CYCLE_TIME_MS = 5.0                        # operations.cc:440
@@ -146,6 +161,7 @@ class Config:
     stall_check_disable: bool = False
     stall_warning_seconds: float = DEFAULT_STALL_WARNING_SECONDS
     stall_shutdown_seconds: float = 0.0
+    collective_deadline: float = 0.0
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
     debug_consistency: bool = False
@@ -185,6 +201,8 @@ class Config:
             stall_warning_seconds=_get_float(
                 HOROVOD_STALL_CHECK_TIME_SECONDS, DEFAULT_STALL_WARNING_SECONDS),
             stall_shutdown_seconds=_get_float(HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, 0.0),
+            collective_deadline=_get_float(
+                HOROVOD_TPU_COLLECTIVE_DEADLINE, 0.0),
             hierarchical_allreduce=_get_bool(HOROVOD_HIERARCHICAL_ALLREDUCE),
             hierarchical_allgather=_get_bool(HOROVOD_HIERARCHICAL_ALLGATHER),
             debug_consistency=_get_bool(HOROVOD_TPU_DEBUG_CONSISTENCY),

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 
 from ..common import env as env_mod
 from ..common.exceptions import DuplicateNameError, HorovodInternalError
+from ..faults import failpoint
 from ..common.lru import lru_get, lru_put, lru_touch
 from ..common.reduce_ops import ReduceOp
 from ..metrics import registry as metrics_registry
@@ -153,6 +154,10 @@ class Handle:
         return self._done
 
     def synchronize(self):
+        # the user-visible completion edge: a hang armed here stalls the
+        # training loop exactly like a peer that stopped contributing
+        failpoint("engine.complete")
+        self._engine._check_poison()
         # poll() first: if the arrays are already ready (the cycle thread
         # just hasn't retired the handle yet) this is not a blocking wait
         # and must not count as one (ADVICE r4 — host_blocks is the
@@ -346,12 +351,22 @@ class Engine:
         # replay observability hooks, wired by GlobalState
         self.on_replay: Optional[Callable[[str, str], None]] = None
         self.replay_fallback_counter: Optional[Callable[[str], None]] = None
+        # join()-idleness hook (wired to the stall inspector): a rank
+        # parked in join() legitimately stops advancing its step
+        # heartbeat, and the collective watchdog's peer leg must not
+        # mistake that for a hang
+        self.on_join_state: Optional[Callable[[bool], None]] = None
         self._hier_ok: Optional[bool] = None
         # One-shot flag: the next engine-method call is a Join zero-tensor
         # substitute — it must skip its own join round (the join() loop
         # already ran it) and send wildcard consistency rows (its auto name
         # legitimately differs from the active ranks' tensor name).
         self._join_substitute = False
+        # Collective-watchdog poison: once the stall inspector's deadline
+        # escalation fires, every subsequent submission/synchronize raises
+        # this error instead of hanging behind the wedged collective —
+        # the engine is unusable until the elastic reset rebuilds it.
+        self._poison: Optional[Exception] = None
         # Cycle loop: the analog of RunLoopOnce (operations.cc:566-616) — wakes
         # every cycle_time_ms to retire completed handles so fire-and-forget
         # async ops clear the outstanding table without user poll/synchronize.
@@ -362,6 +377,17 @@ class Engine:
 
     def stop(self):
         self._running = False
+
+    def poison(self, err: Exception):
+        """Mark the engine dead (collective-watchdog escalation): every
+        later submission, synchronize, barrier, or join raises ``err``.
+        Irreversible for this Engine — the elastic reset path builds a
+        fresh one."""
+        self._poison = err
+
+    def _check_poison(self):
+        if self._poison is not None:
+            raise self._poison
 
     def _cycle_loop(self):
         while self._running:
@@ -429,6 +455,10 @@ class Engine:
         self._m_fill.set(100.0 * total / (len(buckets) * thr))
 
     def _register(self, name: Optional[str], kind: str, nbytes: int) -> str:
+        # every collective submission funnels through here — the canonical
+        # failpoint for "this rank's op never starts"
+        failpoint("engine.enqueue")
+        self._check_poison()
         name = name or self._auto_name(kind)
         with self._lock:
             existing = self._outstanding.get(name)
@@ -529,6 +559,11 @@ class Engine:
         self._last_builder_fresh = False
         if isinstance(names, str):
             names = [names]
+        # a hang armed here models a peer wedged mid-launch: the op is
+        # already in the outstanding table (stall inspector visible), so
+        # the collective watchdog can escalate and break the hang with
+        # HorovodInternalError — the exception the elastic loop recovers
+        failpoint("engine.dispatch")
         self._count_dispatch()
         t0 = time.perf_counter()
         try:
@@ -595,9 +630,19 @@ class Engine:
         # stream is invalid until steady state re-establishes itself
         # (ISSUE r5 tentpole: replay must fall back while join is active).
         self._replay.invalidate_all("join() entered")
+        self._check_poison()
         size = self.backend.size()
         if size <= 1:
             return 0
+        if self.on_join_state is not None:
+            self.on_join_state(True)
+        try:
+            return self._join_loop(size)
+        finally:
+            if self.on_join_state is not None:
+                self.on_join_state(False)
+
+    def _join_loop(self, size: int) -> int:
         if not self.config.join_enabled:
             # legacy behavior: barrier-style consensus only
             self.barrier()
@@ -1427,6 +1472,7 @@ class Engine:
         return h
 
     def barrier(self):
+        self._check_poison()
         sub = self._consume_substitute()
         self._m_account("barrier", [])
         self._replay.observe("barrier", sub)

@@ -47,12 +47,31 @@ class GlobalState:
             self.timeline = Timeline(cfg.timeline_path,
                                      mark_cycles=cfg.timeline_mark_cycles)
             self.timeline.start()
-        if not cfg.stall_check_disable:
+        if not cfg.stall_check_disable or cfg.collective_deadline > 0:
             from ..stall_inspector import StallInspector
+            # collective-watchdog escalation (HOROVOD_TPU_COLLECTIVE_
+            # DEADLINE): poison the engine so every later submission/
+            # synchronize raises instead of queueing behind the wedged
+            # collective; the inspector itself breaks fault-injection
+            # hangs with the same HorovodInternalError.
+            eng = self.engine
+
+            def _escalate(err):
+                eng.poison(err)
+
+            # HOROVOD_STALL_CHECK_DISABLE silences the warning AND
+            # shutdown tiers, but a configured collective deadline still
+            # needs the inspector thread — those thresholds are neutered
+            # (inf / 0) instead of dropping the watchdog on the floor.
+            disabled = cfg.stall_check_disable
             self.stall_inspector = StallInspector(
-                warning_seconds=cfg.stall_warning_seconds,
-                shutdown_seconds=cfg.stall_shutdown_seconds,
-                kv=kv, rank=self.backend.rank(), size=self.backend.size())
+                warning_seconds=(float("inf") if disabled
+                                 else cfg.stall_warning_seconds),
+                shutdown_seconds=(0.0 if disabled
+                                  else cfg.stall_shutdown_seconds),
+                kv=kv, rank=self.backend.rank(), size=self.backend.size(),
+                collective_deadline=cfg.collective_deadline,
+                escalate=_escalate)
         # metrics emitter (horovod_tpu/metrics.py): one thread, three sinks
         # — JSONL file, rendezvous-KV publish (feeds the cluster-aggregated
         # GET /metrics on the runner server), Chrome-trace counter tracks
@@ -154,6 +173,9 @@ class GlobalState:
         engine.on_replay = on_replay
         if stall is not None:
             engine.replay_fallback_counter = stall.record_replay_fallback
+            # a rank parked in join() intentionally stops heartbeating;
+            # the watchdog's peer leg must not read that as a hang
+            engine.on_join_state = stall.set_heartbeat_idle
 
     def shutdown(self):
         with self._lock:

@@ -30,8 +30,12 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..common.env import (HOROVOD_ELASTIC_FAILURE_BACKOFF,
+                          HOROVOD_ELASTIC_SLOT_FAILURE_LIMIT, _get_float,
+                          _get_int)
+from ..faults import failpoint
 from ..metrics import registry as metrics_registry
-from ..runner.hosts import SlotInfo, get_host_assignments
+from ..runner.hosts import HostInfo, SlotInfo, get_host_assignments
 from .discovery import HostDiscovery, HostManager, HostUpdateResult
 from .registration import WorkerStateRegistry
 
@@ -39,6 +43,16 @@ _LOG = logging.getLogger("horovod_tpu.elastic")
 
 DISCOVER_HOSTS_FREQUENCY_SECS = 1.0
 ELASTIC_TIMEOUT_SECS = 600.0
+
+# Slot-failure backoff (ISSUE 4 graceful degradation): a slot that fails
+# repeatedly within this window is suspended with exponential backoff
+# instead of being re-admitted into every rebuilt world (and excluded for
+# good past the strike limit). The first failure is always free — that is
+# the normal crash-recovery relaunch path.
+SLOT_STRIKE_WINDOW_SECS = 600.0
+SLOT_BACKOFF_CAP_SECS = 300.0
+DEFAULT_SLOT_FAILURE_BACKOFF_SECS = 5.0
+DEFAULT_SLOT_FAILURE_LIMIT = 4
 
 
 class ElasticDriver:
@@ -62,6 +76,14 @@ class ElasticDriver:
         self._world_version = 0
         self._pending_resume = False
         self._results: Dict[str, Tuple[object, int]] = {}
+        # per-slot failure strikes: "host:local_rank" -> {count, last,
+        # until} (monotonic). until=inf means permanently excluded.
+        self._slot_strikes: Dict[str, dict] = {}
+        self._failure_backoff = _get_float(
+            HOROVOD_ELASTIC_FAILURE_BACKOFF,
+            DEFAULT_SLOT_FAILURE_BACKOFF_SECS)
+        self._slot_failure_limit = _get_int(
+            HOROVOD_ELASTIC_SLOT_FAILURE_LIMIT, DEFAULT_SLOT_FAILURE_LIMIT)
 
         # membership telemetry (horovod_tpu/metrics.py): the world version
         # as a gauge and rank join/leave/blacklist as a monotonic event log
@@ -185,27 +207,85 @@ class ElasticDriver:
 
     # -- membership / activation --------------------------------------------
 
-    def wait_for_available_slots(self, min_np: int) -> None:
-        """Block until discovery reports at least ``min_np`` usable slots
-        (reference driver.py:118-134)."""
-        deadline = time.monotonic() + self._timeout
-        while not self._shutdown.is_set():
-            self._host_manager.update_available_hosts()
-            avail = self._host_manager.available_slots()
-            if avail >= min_np:
-                return
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"Timed out waiting for {min_np} slots "
-                    f"(have {avail}) after {self._timeout}s. Check that your "
-                    f"discovery script reports enough healthy hosts.")
-            time.sleep(DISCOVER_HOSTS_FREQUENCY_SECS)
-
-    def _activate_workers(self, min_np: int):
-        self.wait_for_available_slots(min_np)
+    def _usable_hosts(self) -> Tuple[List[HostInfo], int]:
+        """Current membership with slot-failure suspensions applied: each
+        host's CAPACITY is reduced by its number of backing-off slots (the
+        assignment always numbers local ranks densely from 0, so this
+        shrinks the host's contribution rather than pinning a particular
+        device — device-bound failures converge via the host blacklist at
+        the strike limit, see ``_record_slot_strike``). If the reduction
+        would drop the total below ``min_np``, suspensions are re-admitted
+        early — keeping the job alive outranks quarantining a flaky
+        slot."""
         with self._lock:
             hosts = self._host_manager.current_hosts()
-            assignments = get_host_assignments(hosts, min_np, self._max_np)
+            now = time.monotonic()
+            suspended: Dict[str, int] = {}
+            for key, ent in list(self._slot_strikes.items()):
+                if ent["until"] > now:
+                    host = key.rsplit(":", 1)[0]
+                    suspended[host] = suspended.get(host, 0) + 1
+            if not suspended:
+                return hosts, sum(h.slots for h in hosts)
+            adjusted = [HostInfo(h.hostname,
+                                 max(h.slots - suspended.get(h.hostname, 0),
+                                     0))
+                        for h in hosts]
+            adjusted = [h for h in adjusted if h.slots > 0]
+            total = sum(h.slots for h in adjusted)
+            if total < self._min_np:
+                _LOG.warning(
+                    "suspending %d failing slot(s) would leave %d < "
+                    "min_np=%d; re-admitting them early to keep the job "
+                    "alive", sum(suspended.values()), total, self._min_np)
+                return hosts, sum(h.slots for h in hosts)
+            return adjusted, total
+
+    def wait_for_available_slots(self, np: int,
+                                 min_np: Optional[int] = None) -> int:
+        """Block until discovery reports at least ``np`` usable slots
+        (reference driver.py:118-134); returns the usable count.
+
+        Degraded-world semantics (ISSUE 4): with ``min_np`` set, a timeout
+        with ``min_np <= usable < np`` *continues degraded* at the smaller
+        world instead of aborting — only ``usable < min_np`` at the
+        deadline is a hard TimeoutError."""
+        min_np = np if min_np is None else min(min_np, np)
+        deadline = time.monotonic() + self._timeout
+        avail = 0
+        while not self._shutdown.is_set():
+            self._host_manager.update_available_hosts()
+            _, avail = self._usable_hosts()
+            if avail >= np:
+                return avail
+            if time.monotonic() > deadline:
+                if avail >= min_np:
+                    _LOG.warning(
+                        "timed out waiting for %d slots after %.0fs; "
+                        "continuing DEGRADED with %d slot(s) "
+                        "(>= min_np=%d)", np, self._timeout, avail, min_np)
+                    self._m_events.append(
+                        "degraded_world", f"requested={np} usable={avail}")
+                    return avail
+                raise TimeoutError(
+                    f"Timed out waiting for {min_np} slots "
+                    f"(have {avail}) after {self._timeout}s — cannot "
+                    f"continue even degraded. Check that your discovery "
+                    f"script reports enough healthy hosts.")
+            time.sleep(DISCOVER_HOSTS_FREQUENCY_SECS)
+        return avail
+
+    def _activate_workers(self, np: int):
+        self.wait_for_available_slots(np, min_np=self._min_np)
+        with self._lock:
+            hosts, total = self._usable_hosts()
+            if total < self._min_np:
+                # membership shrank between the wait and activation
+                raise ValueError(
+                    f"only {total} usable slots at activation, below "
+                    f"min_np={self._min_np}")
+            assignments = get_host_assignments(hosts, min(np, total),
+                                               self._max_np)
             self._world_version += 1
             self._assignments = assignments
             self._pending_resume = False
@@ -246,6 +326,7 @@ class ElasticDriver:
         last_notify = None  # (timestamp, update_res) of the pending change
         while not self._shutdown.is_set():
             try:
+                failpoint("elastic.discovery")
                 res = self._host_manager.update_available_hosts()
             except Exception as e:
                 _LOG.warning("host discovery failed: %s", e)
@@ -334,6 +415,7 @@ class ElasticDriver:
                 # the process is gone either way; a future resume that
                 # reassigns this slot must start a fresh one
                 self._started_slots.discard((host, local_rank))
+                self._slot_strikes.pop(key, None)   # clean exit clears strikes
             self._registry.record_success(host, local_rank)
             self._maybe_finish_on_success()
         else:
@@ -344,6 +426,7 @@ class ElasticDriver:
                                for s in self._assignments)
                 if in_world:
                     self._pending_resume = True
+                    self._record_slot_strike(key)
             if in_world:
                 # READY states recorded when the (now dying) world was
                 # activated are stale: live workers must re-rendezvous
@@ -363,6 +446,52 @@ class ElasticDriver:
                 self._host_manager.blacklist(host)
                 self._m_events.append("blacklist", host)
             self._registry.record_failure(host, local_rank)
+
+    def _record_slot_strike(self, key: str):
+        """Failure accounting for graceful degradation (called under
+        ``self._lock``): the first failure in the strike window is free
+        (normal crash-recovery relaunch); repeats earn exponential-backoff
+        *capacity* suspension — the host offers that many fewer slots to
+        the rebuilt world (which physical local_rank sits idle is the
+        assignment's choice, so this quarantines churn, not a specific
+        device); past the limit the whole HOST is blacklisted (reference
+        driver.py:136-139 behavior) — the only exclusion that converges
+        when the failure is bound to one device. Workers that exit cleanly
+        clear their strikes."""
+        now = time.monotonic()
+        ent = self._slot_strikes.get(key)
+        if ent is None or now - ent["last"] > SLOT_STRIKE_WINDOW_SECS:
+            ent = {"count": 0, "last": now, "until": 0.0}
+        ent["count"] += 1
+        ent["last"] = now
+        if ent["count"] >= self._slot_failure_limit:
+            ent["until"] = float("inf")
+            host = key.rsplit(":", 1)[0]
+            _LOG.error("slot %s has failed %d times; blacklisting host %s "
+                       "(HOROVOD_ELASTIC_SLOT_FAILURE_LIMIT=%d)",
+                       key, ent["count"], host, self._slot_failure_limit)
+            self._host_manager.blacklist(host)
+            self._m_events.append("slot_excluded",
+                                  f"{key} strikes={ent['count']} "
+                                  f"host_blacklisted={host}")
+        elif ent["count"] >= 2:
+            backoff = min(
+                self._failure_backoff * (2.0 ** (ent["count"] - 2)),
+                SLOT_BACKOFF_CAP_SECS)
+            ent["until"] = now + backoff
+            _LOG.warning("slot %s failed %d times within %.0fs; suspending "
+                         "re-admission for %.1fs", key, ent["count"],
+                         SLOT_STRIKE_WINDOW_SECS, backoff)
+            self._m_events.append(
+                "slot_backoff",
+                f"{key} strikes={ent['count']} backoff={backoff:.1f}s")
+        self._slot_strikes[key] = ent
+
+    def slot_strikes(self, key: str) -> int:
+        """Failure-strike count for ``host:local_rank`` (tests/tooling)."""
+        with self._lock:
+            ent = self._slot_strikes.get(key)
+            return ent["count"] if ent else 0
 
     def _host_still_alive(self, host: str) -> bool:
         try:

@@ -12,6 +12,7 @@ from __future__ import annotations
 import logging
 from typing import Dict
 
+from ..faults import DROP, failpoint
 from ..runner.http_server import RendezvousServer
 
 _LOG = logging.getLogger("horovod_tpu.elastic")
@@ -56,6 +57,10 @@ class ElasticRendezvousServer(RendezvousServer):
 
     def handle_get(self, scope: str, key: str, handler):
         if scope == self.SCOPE_RANK and self._driver is not None:
+            # drop() long-polls the worker (a rank that cannot complete its
+            # rendezvous); raise()/hang() model a wedged rendezvous server
+            if failpoint("elastic.rendezvous.get") is DROP:
+                return None
             # key = "<host>:<local_rank>[:<last_world_version>]" — the
             # version lets a resetting worker refuse the plan of the world
             # it just left (driver.get_slot_state docstring).

@@ -23,6 +23,7 @@ import os
 
 from ..common.exceptions import (HorovodInternalError, HostsUpdatedInterrupt,
                                  WorkerRemovedError)
+from ..metrics import registry as metrics_registry
 from .worker import notification_manager
 
 _LOG = logging.getLogger("horovod_tpu.elastic")
@@ -93,6 +94,11 @@ def run_fn(func, reset):
         notification_manager().register_listener(state)
         skip_sync = False
         raw_failures = 0  # consecutive raw-runtime-error recoveries
+        # recovery telemetry: rate()-able evidence of an unstable world
+        # (internal = failed collective, raw_runtime = dataflow-surfaced
+        # peer crash or user-code failure, hosts_updated = membership)
+        _m_recoveries = metrics_registry().counter(
+            "hvd_tpu_elastic_recoveries_total")
         try:
             while True:
                 if not skip_sync:
@@ -103,6 +109,7 @@ def run_fn(func, reset):
                 except _recoverable_errors() as e:
                     if isinstance(e, HorovodInternalError):
                         raw_failures = 0  # definitely a collective failure
+                        _m_recoveries.inc(kind="internal")
                     else:
                         if getattr(state, "_commit_count", 0) > commits_before:
                             raw_failures = 0  # progress since last failure
@@ -116,6 +123,7 @@ def run_fn(func, reset):
                                 "RETRIES=%d)", raw_failures,
                                 _MAX_RUNTIME_ERROR_RETRIES)
                             raise
+                        _m_recoveries.inc(kind="raw_runtime")
                     _LOG.info("collective failure; restoring last committed "
                               "state and re-initializing")
                     state.restore()
@@ -123,6 +131,7 @@ def run_fn(func, reset):
                 except HostsUpdatedInterrupt as e:
                     _LOG.info("hosts updated (skip_sync=%s); "
                               "re-initializing", e.skip_sync)
+                    _m_recoveries.inc(kind="hosts_updated")
                     skip_sync = e.skip_sync
                 try:
                     reset()

@@ -20,6 +20,9 @@ import threading
 from typing import List, Optional
 
 from ..common import env as env_mod
+from ..common.retry import retrying
+from ..faults import failpoint
+from ..metrics import registry as metrics_registry
 from ..runner.http_server import KVStoreServer
 from ..runner.http_client import put_data_into_kvstore
 
@@ -43,7 +46,18 @@ class WorkerNotificationService(KVStoreServer):
                 ts_s, res_s = value.decode().split()
                 self._manager.handle_hosts_updated(int(ts_s), int(res_s))
                 return 200
-            except (ValueError, UnicodeDecodeError):
+            except (ValueError, UnicodeDecodeError) as e:
+                # A malformed payload used to vanish into a bare 400: a
+                # driver/worker version skew then looked like a *lost*
+                # membership event and the worker ran the old world to
+                # completion. Loud + counted (ISSUE 4 satellite).
+                _LOG.warning(
+                    "rejecting malformed hosts-updated notification %r "
+                    "(%s) — likely a driver/worker version skew; this "
+                    "worker did NOT observe the membership change",
+                    value[:64], e)
+                metrics_registry().counter(
+                    "hvd_tpu_notify_rejects_total").inc()
                 return 400
         return super().handle_put(scope, key, value, handler)
 
@@ -91,19 +105,38 @@ class WorkerNotificationManager:
     def reregister(self, rank: Optional[int] = None):
         """Re-advertise this worker's address after a reset: the global rank
         may have changed with the new world, and the old rank's key may have
-        been claimed by another worker."""
+        been claimed by another worker.
+
+        A failed re-registration used to be swallowed at debug level — the
+        driver could then never push membership events to this worker again
+        (it would only learn of changes at its next failed collective).
+        Now: bounded retries via :func:`retrying`, and final failure is a
+        WARNING plus ``hvd_tpu_kv_gave_up_total{op="reregister"}`` (ISSUE 4
+        satellite, same pattern as the PR-3 stall-publish fix)."""
         with self._lock:
             if self._service is None or self._rdv is None:
                 return
             if rank is None:
                 rank = int(os.environ.get(env_mod.HOROVOD_RANK, "0"))
             addr, port = self._rdv
-            try:
-                put_data_into_kvstore(addr, port, SCOPE_WORKER_ADDRS,
-                                      str(rank), self._my_addr.encode(),
-                                      timeout=10)
-            except Exception as e:
-                _LOG.debug("notification re-registration failed: %s", e)
+            my_addr = self._my_addr
+
+        def _attempt():
+            failpoint("elastic.reregister")
+            # retries=0: retrying() owns the schedule, one layer of backoff
+            put_data_into_kvstore(addr, port, SCOPE_WORKER_ADDRS,
+                                  str(rank), my_addr.encode(),
+                                  timeout=10, retries=0)
+
+        try:
+            retrying(_attempt, attempts=4, base_delay=0.1, max_delay=2.0,
+                     deadline=30.0, op="reregister")
+        except Exception as e:
+            _LOG.warning(
+                "notification re-registration for rank %s at %s failed "
+                "after retries: %s — the driver cannot push membership "
+                "events to this worker until a future reset re-advertises "
+                "it", rank, my_addr, e)
 
     def shutdown(self):
         with self._lock:
@@ -144,10 +177,16 @@ class WorkerNotificationClient:
         self._port = int(port)
 
     def notify_hosts_updated(self, timestamp: int, update_res: int):
+        failpoint("elastic.notify")
+        # one-shot (retries=0): the driver re-pushes every discovery tick
+        # while the resume is pending and workers reregister after reset,
+        # so a newer notify always supersedes this one — retrying here
+        # would only keep notify threads to dead endpoints lingering past
+        # the driver's 10s join.
         put_data_into_kvstore(self._host, self._port, SCOPE_NOTIFY,
                               KEY_HOSTS_UPDATED,
                               f"{timestamp} {update_res}".encode(),
-                              timeout=5)
+                              timeout=5, retries=0)
 
 
 _manager: Optional[WorkerNotificationManager] = None

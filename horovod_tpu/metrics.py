@@ -95,6 +95,29 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
     "hvd_tpu_stall_stalled_tensors": (
         "gauge", "Tensors currently outstanding past the stall warning "
                  "threshold"),
+    "hvd_tpu_watchdog_escalations_total": (
+        "counter", "Collective-watchdog deadline escalations (hang "
+                   "converted to HorovodInternalError for elastic "
+                   "recovery)"),
+    # common/retry.py (shared by KV put, worker reregister, publishes)
+    "hvd_tpu_kv_retries_total": (
+        "counter", "Retried control-plane KV operations, by op"),
+    "hvd_tpu_kv_gave_up_total": (
+        "counter", "Control-plane KV operations that exhausted their "
+                   "retry budget, by op"),
+    # faults.py
+    "hvd_tpu_fault_injections_total": (
+        "counter", "Fired fault-injection actions, by failpoint name and "
+                   "action"),
+    # elastic/worker.py
+    "hvd_tpu_notify_rejects_total": (
+        "counter", "Malformed hosts-updated notifications rejected by the "
+                   "worker notification service (likely driver/worker "
+                   "version skew)"),
+    # elastic/run.py
+    "hvd_tpu_elastic_recoveries_total": (
+        "counter", "Elastic run-loop recovery events, by kind (internal, "
+                   "raw_runtime, hosts_updated)"),
     # elastic/driver.py
     "hvd_tpu_elastic_world_version": (
         "gauge", "Current elastic world version (bumps on every resume)"),
@@ -499,7 +522,10 @@ def publish_snapshot(kv: Tuple[str, int], rank: int, snap: dict,
     ``stall/<rank>`` pattern); the server's ``GET /metrics`` aggregates
     them. Shared by the MetricsEmitter and by tests that need a
     deterministic publish."""
+    from .faults import DROP, failpoint
     from .runner.http_client import put_data_into_kvstore
+    if failpoint("metrics.publish") is DROP:
+        return
     put_data_into_kvstore(kv[0], kv[1], METRICS_KV_SCOPE, str(rank),
                           json.dumps(snap).encode(), timeout=timeout)
 

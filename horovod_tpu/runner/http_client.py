@@ -1,6 +1,14 @@
 """HTTP KV client used by workers to talk to the launcher's rendezvous/KV
 server. Parity: reference ``horovod/runner/http/http_client.py:45``
-(read_data_from_kvstore / put_data_into_kvstore)."""
+(read_data_from_kvstore / put_data_into_kvstore).
+
+Hardening (ISSUE 4): both verbs carry ``failpoint()`` markers
+(``kv.read``/``kv.put``) so transient-fabric failures are injectable, the
+long-poll read caps its *per-request* socket timeout (one hung server
+connection can no longer eat the whole deadline), and the write path —
+previously one-shot — retries through :func:`..common.retry.retrying`
+within its deadline.
+"""
 
 from __future__ import annotations
 
@@ -9,6 +17,14 @@ import urllib.error
 import urllib.request
 from typing import Optional
 
+from ..common.retry import retrying
+from ..faults import DROP, failpoint
+
+# Cap on the socket timeout of any single long-poll GET request: a server
+# that accepted the connection and then wedged costs one capped request,
+# not the caller's whole deadline (the retry loop reconnects).
+DEFAULT_PER_REQUEST_TIMEOUT = 5.0
+
 
 def _url(addr: str, port: int, scope: str, key: str) -> str:
     return f"http://{addr}:{port}/{scope}/{key}"
@@ -16,15 +32,23 @@ def _url(addr: str, port: int, scope: str, key: str) -> str:
 
 def read_data_from_kvstore(addr: str, port: int, scope: str, key: str,
                            timeout: float = 60.0,
-                           poll_interval: float = 0.2) -> bytes:
+                           poll_interval: float = 0.2,
+                           per_request_timeout: float =
+                           DEFAULT_PER_REQUEST_TIMEOUT) -> bytes:
     """GET with long-poll semantics: retries on 404 until ``timeout``
-    (the reference's workers block until the launcher publishes the key)."""
+    (the reference's workers block until the launcher publishes the key).
+    Each request's socket timeout is ``min(per_request_timeout,
+    remaining)`` so a hung connection is abandoned and retried instead of
+    consuming the entire deadline."""
     deadline = time.monotonic() + timeout
     last_err: Optional[Exception] = None
     while time.monotonic() < deadline:
+        remaining = max(deadline - time.monotonic(), 0.1)
         try:
+            failpoint("kv.read")
             with urllib.request.urlopen(
-                    _url(addr, port, scope, key), timeout=timeout) as resp:
+                    _url(addr, port, scope, key),
+                    timeout=min(per_request_timeout, remaining)) as resp:
                 return resp.read()
         except urllib.error.HTTPError as e:
             last_err = e
@@ -39,10 +63,35 @@ def read_data_from_kvstore(addr: str, port: int, scope: str, key: str,
 
 
 def put_data_into_kvstore(addr: str, port: int, scope: str, key: str,
-                          value: bytes, timeout: float = 60.0) -> None:
+                          value: bytes, timeout: float = 60.0,
+                          retries: int = 3,
+                          per_request_timeout: float =
+                          DEFAULT_PER_REQUEST_TIMEOUT) -> None:
+    """PUT with bounded retries (exponential backoff + jitter) inside the
+    ``timeout`` deadline. KV writes are idempotent (last-writer-wins per
+    key), so re-submission is always safe. Each attempt's socket timeout
+    is capped like the read path — a hung server connection costs one
+    capped attempt, not the whole deadline. ``retries`` is the number of
+    re-attempts after the first try; 0 is a true one-shot (no retry
+    machinery, no give-up counter — callers that layer their own
+    ``retrying()`` on top use this to keep the abandoned-operation
+    counters honest). Retry/give-up counters are labeled with the scope."""
     if isinstance(value, str):
         value = value.encode()
-    req = urllib.request.Request(_url(addr, port, scope, key), data=value,
-                                 method="PUT")
-    with urllib.request.urlopen(req, timeout=timeout):
-        pass
+    t_end = time.monotonic() + timeout
+
+    def _attempt():
+        if failpoint("kv.put") is DROP:
+            return
+        remaining = max(t_end - time.monotonic(), 0.1)
+        req = urllib.request.Request(_url(addr, port, scope, key),
+                                     data=value, method="PUT")
+        with urllib.request.urlopen(
+                req, timeout=min(per_request_timeout, remaining)):
+            pass
+
+    if retries <= 0:
+        _attempt()
+        return
+    retrying(_attempt, attempts=retries + 1, deadline=timeout,
+             op=f"put:{scope}")

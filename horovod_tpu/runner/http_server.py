@@ -21,6 +21,8 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
+from ..faults import DROP, failpoint
+
 _LOG = logging.getLogger("horovod_tpu.runner")
 
 OK = 200
@@ -82,6 +84,18 @@ class KVStoreServer(ThreadingHTTPServer):
 
     daemon_threads = True
 
+    def handle_error(self, request, client_address):
+        # A client that timed out and reconnected (capped per-request
+        # timeout, fault-injected hangs) leaves this thread writing into a
+        # closed socket — debug noise, not an error worth a traceback.
+        import sys
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
+            _LOG.debug("client %s disconnected mid-response: %s",
+                       client_address, exc)
+            return
+        super().handle_error(request, client_address)
+
     def __init__(self, addr=("0.0.0.0", 0)):
         super().__init__(addr, _KVHandler)
         self._lock = threading.Lock()
@@ -91,6 +105,11 @@ class KVStoreServer(ThreadingHTTPServer):
     # -- handler callbacks --------------------------------------------------
 
     def handle_get(self, scope: str, key: str, handler) -> Optional[bytes]:
+        # hang() here models a server that accepted the connection and
+        # wedged (the capped per-request client timeout's regression seam);
+        # drop() serves a 404 for a key that exists
+        if failpoint("kv.server.get") is DROP:
+            return None
         if scope == METRICS_SCOPE and not key:
             return self._render_metrics()
         with self._lock:
@@ -118,6 +137,10 @@ class KVStoreServer(ThreadingHTTPServer):
         return render_prometheus_cluster(snaps).encode()
 
     def handle_put(self, scope: str, key: str, value: bytes, handler) -> int:
+        # drop() acks 200 without storing — the silent-loss fault the
+        # retry/verify paths must survive
+        if failpoint("kv.server.put") is DROP:
+            return OK
         with self._lock:
             self._store[scope][key] = value
         return OK

@@ -22,8 +22,10 @@ import logging
 import os
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
+from .common.exceptions import HorovodInternalError
+from .faults import DROP, failpoint
 from .metrics import registry as metrics_registry
 
 logger = logging.getLogger("horovod_tpu")
@@ -36,16 +38,35 @@ PUBLISH_FAIL_WARN_AFTER = 3
 
 
 class StallInspector:
+    """Local + cross-rank stall detection, and — when
+    ``collective_deadline`` is set (``HOROVOD_TPU_COLLECTIVE_DEADLINE``) —
+    the **collective watchdog**: a hang that outlives the deadline is
+    escalated instead of merely warned about. Escalation poisons the local
+    engine (via the ``escalate`` hook wired by ``GlobalState``), breaks any
+    armed fault hangs with ``HorovodInternalError``, and thereby converts
+    an infinite stall into the exact exception the elastic run-loop
+    restores-and-retries from (``elastic/run.py``)."""
+
     def __init__(self, warning_seconds: float = 60.0, shutdown_seconds: float = 0.0,
                  check_interval: float = 5.0,
                  kv: Optional[Tuple[str, int]] = None,
-                 rank: int = 0, size: int = 1):
+                 rank: int = 0, size: int = 1,
+                 collective_deadline: float = 0.0,
+                 escalate: Optional[Callable[[Exception], None]] = None):
         self.warning_seconds = warning_seconds
         self.shutdown_seconds = shutdown_seconds
+        self.collective_deadline = collective_deadline
+        self.escalate = escalate
+        if collective_deadline > 0:
+            # the watchdog must FIRE within the deadline, so the tick must
+            # undercut it; disabled-deadline jobs keep the coarse cadence
+            check_interval = min(check_interval,
+                                 max(collective_deadline / 4.0, 0.05))
         self.check_interval = check_interval
         self.kv = kv
         self.rank = rank
         self.size = size
+        self._escalated = False
         self._lock = threading.Lock()
         self._outstanding: Dict[str, float] = {}
         self._warned: set = set()
@@ -64,10 +85,13 @@ class StallInspector:
         self._m_pub_failures = _reg.counter(
             "hvd_tpu_stall_publish_failures_total")
         self._m_stalled = _reg.gauge("hvd_tpu_stall_stalled_tensors")
+        self._m_escalations = _reg.counter(
+            "hvd_tpu_watchdog_escalations_total")
         self._heartbeat_step = -1
         self._heartbeat_time = time.time()
+        self._hb_idle = False
         self._cross_warned: set = set()
-        self._running = True
+        self._stop_evt = threading.Event()
         self._thread = threading.Thread(target=self._watch, name="hvd-stall",
                                         daemon=True)
         self._thread.start()
@@ -104,14 +128,28 @@ class StallInspector:
                 else int(step)
             self._heartbeat_time = time.time()
 
+    def set_heartbeat_idle(self, idle: bool):
+        """Mark this rank's frozen heartbeat as INTENTIONAL (parked in
+        ``hvd.join()``, long eval/checkpoint). Published with the liveness
+        report; the watchdog's peer leg skips idle peers instead of
+        poisoning a healthy world over a rank that ran out of data."""
+        with self._lock:
+            self._hb_idle = bool(idle)
+
     def stalled_tensors(self):
         now = time.monotonic()
         with self._lock:
             return [(n, now - t) for n, t in self._outstanding.items()
                     if now - t > self.warning_seconds]
 
-    def stop(self):
-        self._running = False
+    def stop(self, join: bool = True):
+        """Stop the watch thread. With ``join`` (default) also wait for it
+        to exit, so no zombie publish/aggregate from a stopped inspector
+        races whatever comes next (re-init, tests, armed failpoints)."""
+        self._stop_evt.set()
+        if join and self._thread.is_alive() and \
+                threading.current_thread() is not self._thread:
+            self._thread.join(timeout=10)
 
     # -- cross-rank attribution via the rendezvous KV -----------------------
 
@@ -129,11 +167,20 @@ class StallInspector:
                        "outstanding": stale,
                        "hb_step": self._heartbeat_step,
                        "hb_ts": self._heartbeat_time,
+                       "hb_idle": self._hb_idle,
                        "replay_fallbacks": self.replay_fallbacks}
         try:
+            # drop() models the insidious silently-lost write; raise()/
+            # delay() exercise the retry + WARNING-escalation machinery
+            if failpoint("stall.publish") is DROP:
+                return
+            # one in-call retry (retries=1): publishes are periodic, so a
+            # long backoff would just delay the next tick — the streak
+            # logic above owns persistent-outage escalation
             put_data_into_kvstore(self.kv[0], self.kv[1], KV_SCOPE,
                                   str(self.rank),
-                                  json.dumps(payload).encode(), timeout=5)
+                                  json.dumps(payload).encode(), timeout=5,
+                                  retries=1)
         except Exception as e:
             self._pub_fail_streak += 1
             self._m_pub_failures.inc()
@@ -150,19 +197,24 @@ class StallInspector:
             self._pub_fail_streak = 0
             self._pub_fail_warn_at = PUBLISH_FAIL_WARN_AFTER
 
-    def _aggregate(self):
-        """Rank 0: read every rank's report; attribute stalls to ranks
-        (reference: stall_inspector.cc builds 'missing ranks' per tensor)."""
+    def _read_reports(self, timeout: float = 1.0) -> Dict[int, dict]:
+        """Fetch every rank's liveness report from the KV (best-effort;
+        absent/unparseable ranks are skipped)."""
         from .runner.http_client import read_data_from_kvstore
         reports: Dict[int, dict] = {}
         for r in range(self.size):
             try:
                 raw = read_data_from_kvstore(self.kv[0], self.kv[1], KV_SCOPE,
-                                             str(r), timeout=1,
+                                             str(r), timeout=timeout,
                                              poll_interval=0.1)
                 reports[r] = json.loads(raw)
             except Exception:
                 continue
+        return reports
+
+    def _aggregate(self, reports: Dict[int, dict]):
+        """Rank 0: attribute stalls to ranks from every rank's report
+        (reference: stall_inspector.cc builds 'missing ranks' per tensor)."""
         now = time.time()
         # bound the dedup set: unique per-step tensor names would otherwise
         # grow it for the life of the job
@@ -208,9 +260,88 @@ class StallInspector:
                     "Rank %d has not reported liveness for %.0f s — process "
                     "may be dead or wedged.", r, age)
 
+    # -- collective watchdog (HOROVOD_TPU_COLLECTIVE_DEADLINE) --------------
+
+    def _escalate(self, reason: str):
+        """One-shot deadline escalation: convert a hang into the exception
+        the elastic run-loop already recovers from. Counts + logs, runs the
+        ``escalate`` hook (GlobalState wires engine poisoning there), and
+        breaks any armed fault-injection hangs with the same error."""
+        if self._escalated:
+            return
+        self._escalated = True
+        self._m_escalations.inc()
+        err = HorovodInternalError(
+            f"collective watchdog: {reason} (HOROVOD_TPU_COLLECTIVE_"
+            f"DEADLINE={self.collective_deadline:g}s). Aborting local "
+            f"collectives so the elastic run-loop can restore the last "
+            f"committed state and re-rendezvous.")
+        logger.error("%s", err)
+        if self.escalate is not None:
+            try:
+                self.escalate(err)
+            except Exception as e:
+                logger.warning("watchdog escalation hook failed: %s", e)
+        from . import faults
+        faults.break_hangs(err)
+
+    def _check_collective_deadline(self, items, now: float):
+        """Local leg: an op enqueued but not completed past the deadline is
+        a wedged collective (this rank, or a peer it is waiting on)."""
+        for name, t0 in items:
+            age = now - t0
+            if age > self.collective_deadline:
+                self._escalate(
+                    f"tensor {name!r} has been outstanding for {age:.1f}s "
+                    f"with no completion")
+                return
+
+    def _check_peer_heartbeats(self, reports: Dict[int, dict]):
+        """Cross-rank leg: a peer whose step heartbeat stopped advancing
+        past the deadline while its publisher kept running is hung inside
+        its step. Runs on rank 0 only, off the report sweep it already
+        performs for attribution — every rank sweeping would put O(N^2)
+        GETs per tick on the one rendezvous server. Rank 0's escalation
+        recovers the whole world: its poisoned engine fails its next
+        collective, which surfaces on every peer as the usual failed-
+        collective HorovodInternalError.
+
+        Skew-safe: a peer's staleness is ``rep["ts"] - rep["hb_ts"]`` —
+        both stamped by the SAME remote clock at publish time — never a
+        cross-host clock comparison (an NTP-skewed host must not trigger a
+        cluster-wide false abort). Gated on local evidence the world is
+        ACTIVE, not idle: this rank's own heartbeat is fresh (it is still
+        stepping) OR it has ops outstanding (it is blocked waiting on the
+        hung peer). A lockstep SPMD world where every rank froze inside
+        the same jitted step shows neither signal and cannot be recovered
+        in-process anyway (no Python edge left to raise from) — that
+        terminal case belongs to HOROVOD_STALL_SHUTDOWN_TIME_SECONDS
+        (process abort + driver relaunch, docs/fault_tolerance.md)."""
+        with self._lock:
+            own_active = (bool(self._outstanding) or
+                          (self._heartbeat_step >= 0 and
+                           time.time() - self._heartbeat_time <=
+                           self.collective_deadline))
+        if not own_active:
+            return
+        for r, rep in reports.items():
+            if r == self.rank or rep.get("hb_step", -1) < 0 or \
+                    rep.get("hb_idle"):
+                # hb_idle: the rank declared its frozen heartbeat
+                # intentional (parked in join(), eval, checkpoint)
+                continue
+            age = rep.get("ts", 0.0) - rep.get("hb_ts", 0.0)
+            if age > self.collective_deadline:
+                self._escalate(
+                    f"rank {r} kept publishing liveness but last advanced "
+                    f"its heartbeat (step {rep['hb_step']}) {age:.1f}s "
+                    f"earlier — it is likely hung inside its step")
+                return
+
     def _watch(self):
-        while self._running:
-            time.sleep(self.check_interval)
+        # Event-paced (not time.sleep): stop() wakes the loop immediately,
+        # so shutdown never waits out a long check interval
+        while not self._stop_evt.wait(self.check_interval):
             now = time.monotonic()
             with self._lock:
                 items = list(self._outstanding.items())
@@ -230,7 +361,17 @@ class StallInspector:
                     logger.error("Stalled tensor %s exceeded shutdown threshold "
                                  "%.0f s; aborting.", name, self.shutdown_seconds)
                     os._exit(64)
+            if self.collective_deadline > 0 and not self._escalated:
+                self._check_collective_deadline(items, now)
             if self.kv is not None and self.size > 1:
                 self._publish()
+                # rank 0 only: ONE report sweep per tick, shared by the
+                # watchdog's peer leg and the stall attribution — non-zero
+                # ranks never sweep (their watchdog is the local leg), so
+                # per-tick KV load stays O(N)
                 if self.rank == 0:
-                    self._aggregate()
+                    reports = self._read_reports(timeout=1.0)
+                    if self.collective_deadline > 0 and \
+                            not self._escalated:
+                        self._check_peer_heartbeats(reports)
+                    self._aggregate(reports)

@@ -1,0 +1,508 @@
+"""Chaos suite (ISSUE 4): every failure path exercised deterministically
+through the failpoint subsystem — no real process is ever killed.
+
+Covers the acceptance criteria:
+- a one-shot hang in collective dispatch is converted by the collective
+  watchdog into ``HorovodInternalError`` and the elastic run-loop recovers
+  end-to-end (restore -> reset -> finish at the target step);
+- a transient KV outage (first 3 PUTs fail) loses no stall/metrics/
+  registration writes: retry counters advance and the final KV state is
+  byte-identical to a no-fault run;
+- the long-poll read survives a hung server connection (capped per-request
+  timeout satellite);
+- ``reregister`` retries and escalates loudly (satellite);
+- malformed hosts-updated notifications are rejected loudly (satellite);
+- the elastic run-loop's bounded-retry escalation, failpoint-driven
+  (satellite).
+"""
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import faults
+from horovod_tpu.metrics import publish_snapshot, registry
+from horovod_tpu.runner.http_client import (put_data_into_kvstore,
+                                            read_data_from_kvstore)
+from horovod_tpu.runner.http_server import KVStoreServer
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+@pytest.fixture
+def kv_server():
+    server = KVStoreServer(("127.0.0.1", 0))
+    server.start()
+    yield server
+    faults.disarm()   # release any parked server-side hangs first
+    server.stop()
+
+
+def _kv_state(server) -> dict:
+    with server._lock:
+        return {scope: dict(kv) for scope, kv in server._store.items()}
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: watchdog converts a one-shot collective hang into end-to-end
+# elastic recovery — deterministically, without killing any process.
+# ---------------------------------------------------------------------------
+
+class _CountingState(hvd.elastic.ObjectState):
+    def __init__(self, **kwargs):
+        self.restores = 0
+        super().__init__(**kwargs)
+
+    def restore(self):
+        self.restores += 1
+        super().restore()
+
+
+def test_watchdog_hang_recovery_end_to_end(monkeypatch):
+    """A peer's collective stops completing (modeled by a one-shot hang at
+    the dispatch edge, where the op already sits in the stall inspector's
+    outstanding table). The watchdog must fire within
+    HOROVOD_TPU_COLLECTIVE_DEADLINE, surface HorovodInternalError, and the
+    elastic run-loop must restore the last commit and finish training."""
+    deadline = 1.0
+    monkeypatch.setenv("HOROVOD_TPU_COLLECTIVE_DEADLINE", str(deadline))
+    monkeypatch.delenv("HOROVOD_STALL_CHECK_DISABLE", raising=False)
+    hvd.shutdown()
+    hvd.init()
+    reg = registry()
+    esc_before = reg.counter("hvd_tpu_watchdog_escalations_total").total()
+    rec_before = reg.counter("hvd_tpu_elastic_recoveries_total").value(
+        kind="internal")
+    try:
+        faults.arm("engine.dispatch=hang()")
+        state = _CountingState(batch=0)
+        target = 5
+
+        @hvd.elastic.run
+        def train(state):
+            while state.batch < target:
+                out = np.asarray(hvd.allreduce(
+                    np.ones(2, np.float32), name=f"chaos.b{state.batch}",
+                    op=hvd.Sum))
+                assert out[0] == hvd.size()
+                state.batch += 1
+                state.commit()
+            return state.batch
+
+        t0 = time.monotonic()
+        assert train(state) == target
+        elapsed = time.monotonic() - t0
+        # the hang fired (one-shot) and the watchdog broke it: the whole
+        # recovery must take the deadline plus modest overhead, not the
+        # legacy forever
+        assert elapsed < deadline + 15, elapsed
+        assert state.restores == 1, "run-loop never restored committed state"
+        assert state.batch == target
+        assert reg.counter("hvd_tpu_watchdog_escalations_total").total() \
+            == esc_before + 1
+        assert reg.counter("hvd_tpu_elastic_recoveries_total").value(
+            kind="internal") == rec_before + 1
+        assert faults.hits("engine.dispatch") == 1
+    finally:
+        faults.disarm()
+        hvd.shutdown()
+
+
+def test_watchdog_peer_heartbeat_escalation(kv_server):
+    """SPMD-path watchdog leg: rank 1's step heartbeat freezes while rank
+    0's keeps advancing — rank 0 must escalate (HorovodInternalError to the
+    hook + counter) within the deadline, not merely warn."""
+    from horovod_tpu.common.exceptions import HorovodInternalError
+    from horovod_tpu.stall_inspector import StallInspector
+    addr, port = "127.0.0.1", kv_server.port
+    reg = registry()
+    esc_before = reg.counter("hvd_tpu_watchdog_escalations_total").total()
+    escalations = []
+    r0 = StallInspector(warning_seconds=30, check_interval=0.1,
+                        kv=(addr, port), rank=0, size=2,
+                        collective_deadline=0.5,
+                        escalate=escalations.append)
+    r1 = StallInspector(warning_seconds=30, check_interval=0.1,
+                        kv=(addr, port), rank=1, size=2,
+                        collective_deadline=0.5)
+    try:
+        r1.record_heartbeat(5)            # advances once, then freezes
+        deadline = time.monotonic() + 6.0
+        while time.monotonic() < deadline and not escalations:
+            r0.record_heartbeat()         # rank 0 keeps stepping
+            time.sleep(0.05)
+        assert escalations, "rank 0 watchdog never escalated"
+        assert isinstance(escalations[0], HorovodInternalError)
+        assert "rank 1" in str(escalations[0])
+        assert reg.counter("hvd_tpu_watchdog_escalations_total").total() \
+            > esc_before
+    finally:
+        r0.stop()
+        r1.stop()
+
+
+def test_watchdog_skips_idle_joined_peer(kv_server):
+    """A rank parked in hvd.join() (uneven data) publishes hb_idle: the
+    peer leg must NOT escalate over its legitimately frozen heartbeat."""
+    from horovod_tpu.stall_inspector import StallInspector
+    addr, port = "127.0.0.1", kv_server.port
+    escalations = []
+    r0 = StallInspector(warning_seconds=30, check_interval=0.1,
+                        kv=(addr, port), rank=0, size=2,
+                        collective_deadline=0.4,
+                        escalate=escalations.append)
+    r1 = StallInspector(warning_seconds=30, check_interval=0.1,
+                        kv=(addr, port), rank=1, size=2,
+                        collective_deadline=0.4)
+    try:
+        r1.record_heartbeat(5)
+        r1.set_heartbeat_idle(True)       # what engine.join() wires
+        deadline = time.monotonic() + 2.5
+        while time.monotonic() < deadline:
+            r0.record_heartbeat()
+            time.sleep(0.05)
+        assert not escalations, escalations
+        # ...and leaving join() re-enables the check
+        r1.set_heartbeat_idle(False)
+        deadline = time.monotonic() + 6.0
+        while time.monotonic() < deadline and not escalations:
+            r0.record_heartbeat()
+            time.sleep(0.05)
+        assert escalations, "escalation never resumed after idle cleared"
+    finally:
+        r0.stop()
+        r1.stop()
+
+
+def test_arm_from_kv(kv_server):
+    """The one-place-arms-every-worker path: spec present arms, absent
+    warns+returns False, a bad spec raises (never silently unarmed)."""
+    addr, port = "127.0.0.1", kv_server.port
+    assert faults.arm_from_kv(addr, port, timeout=0.5) is False
+    assert not faults.enabled()
+    put_data_into_kvstore(addr, port, "faults", "spec",
+                          b"test.kvarm=2*noop()", timeout=5)
+    assert faults.arm_from_kv(addr, port, timeout=5) is True
+    assert faults.enabled()
+    faults.failpoint("test.kvarm")
+    assert faults.hits("test.kvarm") == 1
+    faults.disarm()
+    put_data_into_kvstore(addr, port, "faults", "spec",
+                          b"not a valid spec", timeout=5)
+    with pytest.raises(ValueError):
+        faults.arm_from_kv(addr, port, timeout=5)
+
+
+def test_break_hangs_does_not_latch():
+    """After one break, a LATER hang() in the same armed spec must park
+    again (multi-round chaos), not instantly re-raise the stale error."""
+    from horovod_tpu.common.exceptions import HorovodInternalError
+    faults.arm("test.latch=2*hang()")
+    box = {}
+
+    def _blocked(slot):
+        try:
+            faults.failpoint("test.latch")
+            box[slot] = "resumed"
+        except Exception as e:
+            box[slot] = e
+
+    t1 = threading.Thread(target=_blocked, args=("first",), daemon=True)
+    t1.start()
+    time.sleep(0.1)
+    faults.break_hangs(HorovodInternalError("round 1"))
+    t1.join(timeout=5)
+    assert isinstance(box["first"], HorovodInternalError)
+    # second hang must PARK, not inherit the stale break
+    t2 = threading.Thread(target=_blocked, args=("second",), daemon=True)
+    t2.start()
+    time.sleep(0.2)
+    assert t2.is_alive(), "second hang inherited the stale break"
+    faults.break_hangs(None)              # released without error
+    t2.join(timeout=5)
+    assert box["second"] == "resumed"
+
+
+def test_poisoned_engine_raises_instead_of_hanging():
+    """After a watchdog escalation the engine must refuse every later
+    submission with the same HorovodInternalError instead of queueing
+    behind the wedged collective."""
+    from horovod_tpu.common.exceptions import HorovodInternalError
+    hvd.shutdown()
+    hvd.init()
+    try:
+        eng = hvd.global_state().engine
+        eng.poison(HorovodInternalError("watchdog: test"))
+        with pytest.raises(HorovodInternalError):
+            hvd.allreduce(np.ones(2, np.float32), name="poisoned.a")
+        with pytest.raises(HorovodInternalError):
+            hvd.barrier()
+    finally:
+        hvd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: transient KV outage loses no stall/metrics/registration
+# writes — final KV state matches the no-fault run (two-rank write set).
+# ---------------------------------------------------------------------------
+
+def _exercise_kv_writes(addr: str, port: int):
+    """The control-plane write set of a 2-rank world: stall liveness,
+    metrics snapshots, and worker-address registrations for both ranks."""
+    for rank in (0, 1):
+        put_data_into_kvstore(
+            addr, port, "stall", str(rank),
+            json.dumps({"outstanding": [], "hb_step": 7,
+                        "replay_fallbacks": 0}).encode(), timeout=10)
+        publish_snapshot((addr, port), rank,
+                         {"enabled": True, "counters": {}, "gauges": {},
+                          "histograms": {}, "events": {}})
+        put_data_into_kvstore(addr, port, "worker_addresses", str(rank),
+                              f"host{rank}:90{rank}".encode(), timeout=10)
+
+
+def test_kv_outage_loses_no_writes():
+    reg = registry()
+    a = KVStoreServer(("127.0.0.1", 0))
+    b = KVStoreServer(("127.0.0.1", 0))
+    a.start()
+    b.start()
+    try:
+        _exercise_kv_writes("127.0.0.1", a.port)      # no-fault reference
+        retries_before = reg.counter("hvd_tpu_kv_retries_total").total()
+        faults.arm("kv.put=3*raise(ConnectionError)")  # transient outage
+        _exercise_kv_writes("127.0.0.1", b.port)
+        faults.disarm()
+        assert faults.hits("kv.put") == 0  # disarmed resets accounting
+        assert _kv_state(a) == _kv_state(b), \
+            "KV state diverged: the outage lost writes"
+        assert reg.counter("hvd_tpu_kv_retries_total").total() \
+            >= retries_before + 3
+    finally:
+        faults.disarm()
+        a.stop()
+        b.stop()
+
+
+def test_read_survives_hung_server_connection(kv_server):
+    """Satellite: the long-poll GET used to pass its WHOLE deadline as the
+    per-request socket timeout, so one hung connection consumed it all.
+    With the cap, a hung connection costs one capped request and the retry
+    reconnects."""
+    addr, port = "127.0.0.1", kv_server.port
+    put_data_into_kvstore(addr, port, "scope", "k", b"v42", timeout=5)
+    faults.arm("kv.server.get=hang()")   # first connection wedges forever
+    t0 = time.monotonic()
+    out = read_data_from_kvstore(addr, port, "scope", "k", timeout=8.0,
+                                 poll_interval=0.05,
+                                 per_request_timeout=0.3)
+    elapsed = time.monotonic() - t0
+    assert out == b"v42"
+    assert elapsed < 4.0, \
+        f"hung connection consumed the deadline ({elapsed:.1f}s)"
+
+
+def test_put_survives_hung_server_connection(kv_server):
+    """The write path gets the same per-request cap as the read path: a
+    server that accepts the PUT connection and wedges costs one capped
+    attempt, and the retry loop lands the write within the deadline."""
+    addr, port = "127.0.0.1", kv_server.port
+    faults.arm("kv.server.put=hang()")   # first PUT connection wedges
+    t0 = time.monotonic()
+    put_data_into_kvstore(addr, port, "scope", "pk", b"pv", timeout=10,
+                          per_request_timeout=0.3)
+    elapsed = time.monotonic() - t0
+    faults.disarm()
+    assert elapsed < 4.0, \
+        f"hung PUT connection consumed the deadline ({elapsed:.1f}s)"
+    assert _kv_state(kv_server)["scope"]["pk"] == b"pv"
+
+
+def test_reregister_retries_then_escalates_loudly(kv_server, caplog):
+    """Satellite: a failed post-reset re-registration was swallowed at
+    debug level. Transient failures must be retried to success; a
+    permanent outage must WARN and count into the give-up counter."""
+    from horovod_tpu.elastic.worker import WorkerNotificationManager
+    addr, port = "127.0.0.1", kv_server.port
+    reg = registry()
+    mgr = WorkerNotificationManager()
+    mgr.init(rendezvous_addr=addr, rendezvous_port=port, rank=0,
+             hostname="hostA")
+    try:
+        # transient: two failures, then the KV heals — the write must land
+        faults.arm("elastic.reregister=2*raise(ConnectionError)")
+        mgr.reregister(rank=3)
+        assert _kv_state(kv_server)["worker_addresses"]["3"] \
+            == _kv_state(kv_server)["worker_addresses"]["0"]
+        # permanent: every attempt fails — WARNING + give-up counter
+        gave_before = reg.counter("hvd_tpu_kv_gave_up_total").value(
+            op="reregister")
+        faults.arm("elastic.reregister=*raise(ConnectionError)")
+        with caplog.at_level(logging.WARNING, logger="horovod_tpu.elastic"):
+            mgr.reregister(rank=4)
+        assert any("re-registration" in r.message and
+                   r.levelno == logging.WARNING for r in caplog.records)
+        assert reg.counter("hvd_tpu_kv_gave_up_total").value(
+            op="reregister") == gave_before + 1
+        assert "4" not in _kv_state(kv_server).get("worker_addresses", {})
+    finally:
+        faults.disarm()
+        mgr.shutdown()
+
+
+def test_malformed_notify_rejected_loudly(caplog):
+    """Satellite: a malformed hosts-updated payload used to 400 with no
+    trace — an invisible lost membership event under driver/worker version
+    skew. Now: WARNING + hvd_tpu_notify_rejects_total."""
+    from horovod_tpu.elastic.worker import (WorkerNotificationManager,
+                                            WorkerNotificationService)
+    mgr = WorkerNotificationManager()
+    svc = WorkerNotificationService(mgr)
+    svc.start()
+    reg = registry()
+    before = reg.counter("hvd_tpu_notify_rejects_total").total()
+    try:
+        with caplog.at_level(logging.WARNING, logger="horovod_tpu.elastic"):
+            with pytest.raises(urllib.error.HTTPError):
+                put_data_into_kvstore("127.0.0.1", svc.port, "notify",
+                                      "hosts_updated", b"not a payload",
+                                      timeout=5, retries=0)
+        assert reg.counter("hvd_tpu_notify_rejects_total").total() \
+            == before + 1
+        assert any("version skew" in r.message for r in caplog.records)
+        # a well-formed payload still goes through to listeners
+        got = []
+
+        class _L:
+            def on_hosts_updated(self, ts, res):
+                got.append((ts, res))
+
+        mgr.register_listener(_L())
+        put_data_into_kvstore("127.0.0.1", svc.port, "notify",
+                              "hosts_updated", b"123 1", timeout=5)
+        assert got == [(123, 1)]
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: elastic run-loop bounded-retry escalation, failpoint-driven
+# (no subprocess kills).
+# ---------------------------------------------------------------------------
+
+class _FakeState:
+    def __init__(self):
+        self.restores = 0
+        self.syncs = 0
+        self._commit_count = 0
+
+    def sync(self):
+        self.syncs += 1
+
+    def restore(self):
+        self.restores += 1
+
+    def on_reset(self):
+        pass
+
+    def commit(self):
+        self._commit_count += 1
+
+
+class TestRunLoopEscalationChaos:
+    @pytest.fixture(autouse=True)
+    def _no_rendezvous(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_GLOO_RENDEZVOUS_ADDR", raising=False)
+
+    def _budget(self):
+        import importlib
+        return importlib.import_module(
+            "horovod_tpu.elastic.run")._MAX_RUNTIME_ERROR_RETRIES
+
+    def test_consecutive_raw_failures_escalate(self):
+        import jax
+        import importlib
+        run_fn = importlib.import_module("horovod_tpu.elastic.run").run_fn
+        budget = self._budget()
+        faults.arm("test.runloop=*raise(JaxRuntimeError)")
+        state = _FakeState()
+        attempts = []
+
+        def train(s):
+            attempts.append(1)
+            faults.failpoint("test.runloop")
+            return "unreachable"
+
+        with pytest.raises(jax.errors.JaxRuntimeError):
+            run_fn(train, lambda: None)(state)
+        assert len(attempts) == budget + 1
+        assert state.restores == budget
+
+    def test_progress_resets_the_counter(self):
+        import importlib
+        run_fn = importlib.import_module("horovod_tpu.elastic.run").run_fn
+        budget = self._budget()
+        n_fail = budget * 2
+        faults.arm(f"test.runloop={n_fail}*raise(JaxRuntimeError)")
+        state = _FakeState()
+
+        def train(s):
+            s.commit()                      # progress before every failure
+            faults.failpoint("test.runloop")
+            return "done"
+
+        assert run_fn(train, lambda: None)(state) == "done"
+        assert state.restores == n_fail     # every failure recovered
+
+    def test_internal_error_never_counts(self):
+        reg = registry()
+        import importlib
+        run_fn = importlib.import_module("horovod_tpu.elastic.run").run_fn
+        budget = self._budget()
+        n_fail = budget * 3                 # far past the raw budget
+        rec_before = reg.counter("hvd_tpu_elastic_recoveries_total").value(
+            kind="internal")
+        faults.arm(f"test.runloop={n_fail}*raise(HorovodInternalError)")
+        state = _FakeState()
+
+        def train(s):
+            faults.failpoint("test.runloop")   # NO commits, all internal
+            return "done"
+
+        assert run_fn(train, lambda: None)(state) == "done"
+        assert state.restores == n_fail
+        assert reg.counter("hvd_tpu_elastic_recoveries_total").value(
+            kind="internal") == rec_before + n_fail
+
+    def test_internal_error_resets_raw_streak(self):
+        """Interleaved raw/internal failures: each HorovodInternalError
+        resets the consecutive-raw counter, so raw streaks below the budget
+        never escalate even when the total is far past it."""
+        import importlib
+        run_fn = importlib.import_module("horovod_tpu.elastic.run").run_fn
+        budget = self._budget()
+        chain = "->".join(
+            [f"{budget}*raise(JaxRuntimeError)", "raise(HorovodInternalError)"]
+            * 3)
+        faults.arm(f"test.runloop={chain}")
+        state = _FakeState()
+
+        def train(s):
+            faults.failpoint("test.runloop")
+            return "done"
+
+        assert run_fn(train, lambda: None)(state) == "done"
+        assert state.restores == (budget + 1) * 3

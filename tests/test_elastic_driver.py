@@ -218,6 +218,101 @@ class TestElasticDriver:
             driver.stop()
             server.stop()
 
+    def test_degraded_world_on_timeout(self):
+        """ISSUE 4: requesting np=4 with only 2 slots discoverable times
+        out into a DEGRADED world at 2 (>= min_np) instead of aborting."""
+        driver, server, disc, workers = make_driver({"a": 2}, 2,
+                                                    timeout=1.5)
+        try:
+            driver.start(4, workers.create)      # must not raise
+            assert driver.world_size() == 2
+            assert len(workers.started) == 2
+        finally:
+            driver.stop()
+            server.stop()
+
+    def test_timeout_below_min_np_aborts(self):
+        """The other timeout arm: fewer usable slots than min_np is a hard
+        TimeoutError, degraded continuation is not an option."""
+        driver, server, disc, workers = make_driver({"a": 1}, 2,
+                                                    timeout=1.5)
+        try:
+            with pytest.raises(TimeoutError, match="cannot continue"):
+                driver.start(2, workers.create)
+        finally:
+            driver.stop()
+            server.stop()
+
+    def test_repeat_failing_slot_suspended_with_backoff(self, monkeypatch):
+        """A slot that fails repeatedly is suspended (world rebuilt without
+        it) instead of re-admitted into every world; after the backoff
+        expires it becomes usable again."""
+        monkeypatch.setenv("HOROVOD_ELASTIC_FAILURE_BACKOFF", "1.0")
+        driver, server, disc, workers = make_driver({"a": 3}, 1, max_np=3)
+        try:
+            driver.start(3, workers.create)
+            assert driver.world_size() == 3
+            v1 = driver.world_version
+            # strike 1 (free): slot a:2 dies, world rebuilt at 3
+            driver.record_worker_exit("a", 2, exit_code=1)
+            for lr in (0, 1):
+                driver.record_ready("a", lr)
+            assert wait_until(lambda: driver.world_version > v1)
+            assert driver.world_size() == 3
+            assert driver.slot_strikes("a:2") == 1
+            v2 = driver.world_version
+            # strike 2: suspension kicks in, the rebuilt world excludes it
+            driver.record_worker_exit("a", 2, exit_code=1)
+            for lr in (0, 1):
+                driver.record_ready("a", lr)
+            assert wait_until(lambda: driver.world_version > v2)
+            assert driver.slot_strikes("a:2") == 2
+            assert driver.world_size() == 2
+            # after the ~1s backoff the slot is usable again
+            assert wait_until(
+                lambda: driver._usable_hosts()[1] == 3, timeout=10)
+        finally:
+            driver.stop()
+            server.stop()
+
+    def test_slot_failure_limit_blacklists_host(self, monkeypatch):
+        """Past HOROVOD_ELASTIC_SLOT_FAILURE_LIMIT the failing slot's HOST
+        is blacklisted (capacity suspension alone cannot pin a physical
+        device, so only the host exclusion converges)."""
+        monkeypatch.setenv("HOROVOD_ELASTIC_SLOT_FAILURE_LIMIT", "3")
+        driver, server, disc, workers = make_driver({"a": 1, "b": 1}, 1,
+                                                    max_np=2)
+        try:
+            driver.start(2, workers.create)
+            for _ in range(3):
+                driver.record_worker_exit("b", 0, exit_code=1)
+            assert driver.slot_strikes("b:0") == 3
+            assert driver.host_manager.is_blacklisted("b")
+            assert not driver.host_manager.is_blacklisted("a")
+        finally:
+            driver.stop()
+            server.stop()
+
+    def test_suspension_readmitted_to_preserve_min_np(self, monkeypatch):
+        """Quarantine never starves the job: when suspending the striking
+        slots would drop the world below min_np, they are re-admitted."""
+        monkeypatch.setenv("HOROVOD_ELASTIC_FAILURE_BACKOFF", "30")
+        driver, server, disc, workers = make_driver({"a": 2}, 2, max_np=2)
+        try:
+            driver.start(2, workers.create)
+            v1 = driver.world_version
+            for _ in range(2):   # two strikes on a:1 → would suspend it
+                driver.record_worker_exit("a", 1, exit_code=1)
+            driver.record_ready("a", 0)
+            driver.record_ready("a", 1)
+            assert wait_until(lambda: driver.world_version > v1)
+            # min_np=2 forces re-admission despite the strikes
+            assert driver.world_size() == 2
+            assert driver.slot_strikes("a:1") == 2
+        finally:
+            driver.stop()
+            server.stop()
+
 
 class TestElasticRendezvous:
     def test_get_records_ready_and_serves_slots(self):

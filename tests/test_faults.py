@@ -1,0 +1,237 @@
+"""Failpoint subsystem unit tests (ISSUE 4 tentpole): spec grammar, action
+semantics, per-rank targeting, disabled-mode freeness, namespace lint, and
+the shared retry helper."""
+
+import logging
+import threading
+import time
+
+import pytest
+
+from horovod_tpu import faults
+from horovod_tpu.common.retry import retrying
+from horovod_tpu.metrics import registry
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+# -- grammar ----------------------------------------------------------------
+
+class TestGrammar:
+    def test_bad_clause_shapes(self):
+        for spec in ("nonsense", "test.x", "test.x=frobnicate(1)",
+                     "test.x=raise()", "test.x=0*drop()",
+                     "test.x=delay(xyz)", "test.x=drop(5)",
+                     "BadName=drop()", "test.x=raise(NoSuchExc)"):
+            with pytest.raises(ValueError):
+                faults.arm(spec)
+        assert not faults.enabled()
+
+    def test_undeclared_name_rejected_at_arm(self):
+        with pytest.raises(ValueError, match="FAULT_SPECS"):
+            faults.arm("engine.not_a_real_point=drop()")
+
+    def test_test_prefix_exempt(self):
+        faults.arm("test.anything.goes=noop()")
+        assert faults.enabled()
+
+    def test_durations(self):
+        faults.arm("test.a=delay(50ms)")
+        t0 = time.monotonic()
+        faults.failpoint("test.a")
+        assert 0.03 < time.monotonic() - t0 < 0.5
+
+    def test_exception_resolution_layers(self):
+        import jax
+        faults.arm("test.b=raise(HorovodInternalError)"
+                   "->raise(JaxRuntimeError)->raise(TimeoutError)")
+        from horovod_tpu.common.exceptions import HorovodInternalError
+        with pytest.raises(HorovodInternalError):
+            faults.failpoint("test.b")
+        with pytest.raises(jax.errors.JaxRuntimeError):
+            faults.failpoint("test.b")
+        with pytest.raises(TimeoutError):
+            faults.failpoint("test.b")
+        assert faults.failpoint("test.b") is None  # exhausted
+
+
+# -- action semantics -------------------------------------------------------
+
+class TestActions:
+    def test_counted_chain_then_exhaustion(self):
+        faults.arm("test.c=2*raise(ConnectionError)->drop()")
+        for _ in range(2):
+            with pytest.raises(ConnectionError):
+                faults.failpoint("test.c")
+        assert faults.failpoint("test.c") is faults.DROP
+        assert faults.failpoint("test.c") is None
+        assert faults.hits("test.c") == 3
+
+    def test_star_count_fires_forever(self):
+        faults.arm("test.d=*drop()")
+        for _ in range(10):
+            assert faults.failpoint("test.d") is faults.DROP
+
+    def test_injection_counter(self):
+        ctr = registry().counter("hvd_tpu_fault_injections_total")
+        before = ctr.value(name="test.e", action="noop")
+        faults.arm("test.e=3*noop()")
+        for _ in range(3):
+            faults.failpoint("test.e")
+        assert ctr.value(name="test.e", action="noop") == before + 3
+
+    def test_per_rank_targeting(self, monkeypatch):
+        faults.arm("test.f@1=*drop()")
+        monkeypatch.setenv("HOROVOD_RANK", "0")
+        assert faults.failpoint("test.f") is None
+        monkeypatch.setenv("HOROVOD_RANK", "1")
+        assert faults.failpoint("test.f") is faults.DROP
+
+    def test_hang_broken_with_exception(self):
+        from horovod_tpu.common.exceptions import HorovodInternalError
+        faults.arm("test.g=hang()")
+        box = {}
+
+        def _blocked():
+            try:
+                faults.failpoint("test.g")
+                box["out"] = "resumed"
+            except Exception as e:
+                box["out"] = e
+
+        t = threading.Thread(target=_blocked, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        assert t.is_alive(), "hang() did not block"
+        faults.break_hangs(HorovodInternalError("watchdog abort"))
+        t.join(timeout=5)
+        assert isinstance(box["out"], HorovodInternalError)
+
+    def test_hang_with_duration_resumes(self):
+        faults.arm("test.h=hang(100ms)")
+        t0 = time.monotonic()
+        assert faults.failpoint("test.h") is None
+        assert 0.05 < time.monotonic() - t0 < 2.0
+
+    def test_disarm_releases_parked_hangs(self):
+        faults.arm("test.i=hang()")
+        done = threading.Event()
+
+        def _blocked():
+            faults.failpoint("test.i")
+            done.set()
+
+        t = threading.Thread(target=_blocked, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        faults.disarm()
+        assert done.wait(timeout=5), "disarm did not release the hang"
+
+    def test_disabled_is_noop(self):
+        assert not faults.enabled()
+        assert faults.failpoint("engine.enqueue") is None
+        assert faults.hits("engine.enqueue") == 0
+
+
+# -- namespace lint (tools/check_fault_names.py, tier-1 wiring) -------------
+
+class TestFaultNameLint:
+    def test_declared_specs_clean(self):
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+        from tools.check_fault_names import (scan_call_sites,
+                                             validate_call_sites,
+                                             validate_specs)
+        assert validate_specs(faults.FAULT_SPECS) == []
+        pkg_root = os.path.join(os.path.dirname(__file__), "..",
+                                "horovod_tpu")
+        sites = scan_call_sites(pkg_root)
+        assert sites, "no failpoint call sites found — scan broken?"
+        assert validate_call_sites(faults.FAULT_SPECS, sites) == []
+
+    def test_lint_catches_undeclared_call_site(self):
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+        from tools.check_fault_names import validate_call_sites
+        errs = validate_call_sites(faults.FAULT_SPECS,
+                                   [("x.py", 3, "engine.bogus")])
+        assert len(errs) == 1 and "engine.bogus" in errs[0]
+
+    def test_lint_catches_bad_declarations(self):
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+        from tools.check_fault_names import validate_specs
+        errs = validate_specs({"NotKebab": "x", "test.reserved": "y",
+                               "ok.name": ""})
+        assert len(errs) == 3
+
+
+# -- retrying() helper ------------------------------------------------------
+
+class TestRetrying:
+    def test_succeeds_after_transient_failures(self):
+        reg = registry()
+        retries_before = reg.counter("hvd_tpu_kv_retries_total").value(
+            op="t1")
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("transient")
+            return "ok"
+
+        assert retrying(flaky, attempts=5, base_delay=0.01, op="t1") == "ok"
+        assert len(calls) == 3
+        assert reg.counter("hvd_tpu_kv_retries_total").value(
+            op="t1") == retries_before + 2
+
+    def test_gives_up_and_counts(self):
+        reg = registry()
+        gave_before = reg.counter("hvd_tpu_kv_gave_up_total").value(op="t2")
+
+        def dead():
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            retrying(dead, attempts=3, base_delay=0.01, op="t2")
+        assert reg.counter("hvd_tpu_kv_gave_up_total").value(
+            op="t2") == gave_before + 1
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = []
+
+        def broken():
+            calls.append(1)
+            raise ValueError("bug, not weather")
+
+        with pytest.raises(ValueError):
+            retrying(broken, attempts=5, base_delay=0.01, op="t3")
+        assert len(calls) == 1
+
+    def test_deadline_bounds_attempts(self):
+        calls = []
+
+        def slow_fail():
+            calls.append(1)
+            raise ConnectionError("x")
+
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            retrying(slow_fail, attempts=50, base_delay=0.2, max_delay=0.2,
+                     jitter=0.0, deadline=0.5, op="t4")
+        assert time.monotonic() - t0 < 2.0
+        assert 1 <= len(calls) <= 4
+
+    def test_backoff_schedule_shape(self):
+        from horovod_tpu.common.retry import backoff_delays
+        delays = list(backoff_delays(5, 0.1, 0.4, jitter=0.0))
+        assert delays == [0.1, 0.2, 0.4, 0.4]
