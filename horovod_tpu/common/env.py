@@ -97,6 +97,15 @@ HOROVOD_TPU_SHARD_OPTIMIZER = "HOROVOD_TPU_SHARD_OPTIMIZER"
 # a failpoint spec string; unset means every failpoint() marker is a
 # no-op. Parsed by faults._arm_from_env at import.
 HOROVOD_TPU_FAULTS = "HOROVOD_TPU_FAULTS"
+# cross-rank collective tracing (horovod_tpu/trace.py): =0 disables the
+# trace recorder entirely (engine.trace stays None — no per-dispatch
+# locking, the HOROVOD_TPU_METRICS=0 discipline); RING bounds the
+# in-memory event ring; INTERVAL (seconds) paces the trace-segment KV
+# publisher; DUMP_DIR is where the watchdog's flight-recorder dump lands
+HOROVOD_TPU_TRACE = "HOROVOD_TPU_TRACE"
+HOROVOD_TPU_TRACE_RING = "HOROVOD_TPU_TRACE_RING"
+HOROVOD_TPU_TRACE_INTERVAL = "HOROVOD_TPU_TRACE_INTERVAL"
+HOROVOD_TPU_TRACE_DUMP_DIR = "HOROVOD_TPU_TRACE_DUMP_DIR"
 # collective watchdog (stall_inspector.py): seconds a collective may sit
 # outstanding — or a peer heartbeat may lag — before the inspector aborts
 # local collectives and raises HorovodInternalError so the elastic
@@ -178,6 +187,10 @@ class Config:
     # the emitter knobs live here
     metrics_file: Optional[str] = None
     metrics_interval: float = 10.0
+    trace_enabled: bool = True
+    trace_ring: int = 4096
+    trace_interval: float = 5.0
+    trace_dump_dir: Optional[str] = None
     extra: dict = field(default_factory=dict)
 
     @classmethod
@@ -216,4 +229,8 @@ class Config:
             shard_optimizer=_get_bool(HOROVOD_TPU_SHARD_OPTIMIZER, False),
             metrics_file=os.environ.get(HOROVOD_TPU_METRICS_FILE) or None,
             metrics_interval=_get_float(HOROVOD_TPU_METRICS_INTERVAL, 10.0),
+            trace_enabled=_get_bool(HOROVOD_TPU_TRACE, True),
+            trace_ring=_get_int(HOROVOD_TPU_TRACE_RING, 4096),
+            trace_interval=_get_float(HOROVOD_TPU_TRACE_INTERVAL, 5.0),
+            trace_dump_dir=os.environ.get(HOROVOD_TPU_TRACE_DUMP_DIR) or None,
         )

@@ -308,6 +308,13 @@ class Engine:
         # observability hooks, wired by GlobalState when timeline/stall are on
         self.on_enqueue: Optional[Callable[[str, str, int], None]] = None
         self.on_done: Optional[Callable[[str], None]] = None
+        # cross-rank trace recorder (horovod_tpu/trace.py), wired by
+        # GlobalState unless HOROVOD_TPU_TRACE=0: stamps every collective
+        # with a deterministic correlation id at enqueue and records the
+        # enqueue/dispatch/complete phases into a bounded ring. When None
+        # (tracing off) each hook site below is a single is-None check —
+        # the HOROVOD_TPU_METRICS=0 no-new-locking guarantee.
+        self.trace = None
         # per-activity sub-span hook (timeline ACTIVITY events, the nested
         # spans of timeline.h:77 NEGOTIATING->TOP_LEVEL->ACTIVITY)
         self.on_activity: Optional[Callable[[str, str, float], None]] = None
@@ -470,6 +477,10 @@ class Engine:
                 raise DuplicateNameError(
                     f"Duplicate tensor name {name!r} submitted before the prior "
                     f"operation completed (common.h:163-166)")
+        if self.trace is not None:
+            # stamp the correlation id BEFORE the on_enqueue hook so the
+            # timeline closure can tag its span with trace.live_corr(name)
+            self.trace.record_enqueue(name, kind, nbytes, self.world_version)
         if self.on_enqueue is not None:
             self.on_enqueue(name, kind, nbytes)
         return name
@@ -485,10 +496,14 @@ class Engine:
         step_end the engine records the ordered dispatch stream; once the
         same signature repeats ``step_replay_warmup`` times, matching steps
         are serviced by a single fused XLA launch (see core/replay.py)."""
+        if self.trace is not None:
+            self.trace.record_step(begin=True)
         self._replay.step_begin()
 
     def step_end(self):
         self._replay.step_end()
+        if self.trace is not None:
+            self.trace.record_step(begin=False)
 
     def _refresh_world_version(self) -> int:
         """Pick up an elastic world-version bump. A reset normally rebuilds
@@ -569,6 +584,9 @@ class Engine:
         try:
             return _translate_failure(fn, *args)
         finally:
+            if self.trace is not None:
+                self.trace.record_dispatch(names, activity,
+                                           time.perf_counter() - t0)
             if self.on_activity is not None:
                 dur = (time.perf_counter() - t0) * 1e6
                 for n in names:
@@ -859,6 +877,8 @@ class Engine:
         if self._m_enabled and h.kind is not None:
             self._m_latency.observe(time.monotonic() - h._enqueue_mono,
                                     kind=h.kind)
+        if self.trace is not None:
+            self.trace.record_done(h.name)
         if self.on_done is not None:
             self.on_done(h.name)
 

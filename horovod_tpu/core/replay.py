@@ -532,12 +532,22 @@ class StepReplay:
                               armed.segments,
                               sharded_updates=eng._sharded_updates))
         rep_name = f"replay.step.{self._step_token & 1023}"
+        if eng.trace is not None:
+            # the fused launch bypasses _register: stamp its correlation id
+            # here so replayed steps stay joinable across ranks (every rank
+            # replays the same stream in the same step, so the per-name
+            # sequence numbers agree)
+            eng.trace.record_enqueue(rep_name, "replay", armed.nbytes,
+                                     eng.world_version)
         if eng.on_enqueue is not None:
             eng.on_enqueue(rep_name, "replay", armed.nbytes)
         t0 = time.perf_counter()
         outs = engine_mod._translate_failure(
             lambda: fn(*[eng.backend.world_view(t) for t in flat]))
         eng._count_dispatch()
+        if eng.trace is not None:
+            eng.trace.record_dispatch(rep_name, "XLA_REPLAY_DISPATCH",
+                                      time.perf_counter() - t0)
         if eng.on_activity is not None:
             eng.on_activity(rep_name, "XLA_REPLAY_DISPATCH",
                             (time.perf_counter() - t0) * 1e6)

@@ -22,6 +22,8 @@ class GlobalState:
         self.stall_inspector = None
         self.parameter_manager = None
         self.metrics_emitter = None
+        self.trace_recorder = None
+        self.trace_publisher = None
 
     def init(self):
         with self._lock:
@@ -42,11 +44,34 @@ class GlobalState:
         rdv_port = os.environ.get(env_mod.HOROVOD_GLOO_RENDEZVOUS_PORT)
         if rdv_addr and rdv_port:
             kv = (rdv_addr, int(rdv_port))
-        if cfg.timeline_path and self.backend.rank() == 0:
+        if cfg.timeline_path:
             from ..timeline import Timeline
-            self.timeline = Timeline(cfg.timeline_path,
-                                     mark_cycles=cfg.timeline_mark_cycles)
+            # every rank records its own local timeline (pid = rank, so
+            # two ranks' files overlay in one viewer); rank 0 keeps the
+            # exact configured path, other ranks suffix it to avoid
+            # clobbering on a shared filesystem
+            rank = self.backend.rank()
+            path = (cfg.timeline_path if rank == 0
+                    else f"{cfg.timeline_path}.rank{rank}")
+            self.timeline = Timeline(path,
+                                     mark_cycles=cfg.timeline_mark_cycles,
+                                     pid=rank)
             self.timeline.start()
+        # cross-rank trace recorder (horovod_tpu/trace.py): stamps every
+        # collective with a correlation id and records per-phase spans in a
+        # bounded ring; a publisher ships segments to the rendezvous KV
+        # (trace/<rank>) for the merged GET /trace. HOROVOD_TPU_TRACE=0
+        # leaves engine.trace None — zero new work on the dispatch path.
+        if cfg.trace_enabled:
+            from ..trace import TracePublisher, TraceRecorder
+            self.trace_recorder = TraceRecorder(rank=self.backend.rank(),
+                                                capacity=cfg.trace_ring)
+            self.engine.trace = self.trace_recorder
+            if kv is not None:
+                self.trace_publisher = TracePublisher(
+                    self.trace_recorder, kv, rank=self.backend.rank(),
+                    interval=cfg.trace_interval)
+                self.trace_publisher.start()
         if not cfg.stall_check_disable or cfg.collective_deadline > 0:
             from ..stall_inspector import StallInspector
             # collective-watchdog escalation (HOROVOD_TPU_COLLECTIVE_
@@ -58,6 +83,22 @@ class GlobalState:
 
             def _escalate(err):
                 eng.poison(err)
+
+            # flight recorder (horovod_tpu/trace.py): the one-shot
+            # escalation dumps the last-N in-memory trace spans to disk
+            # BEFORE the engine is poisoned, so a hang post-mortem always
+            # has the spans that led into it.
+            recorder = self.trace_recorder
+            rank = self.backend.rank()
+            dump_dir = cfg.trace_dump_dir
+
+            def _flight_dump():
+                if recorder is None:
+                    return None
+                path = os.path.join(
+                    dump_dir or os.getcwd(),
+                    f"hvd_tpu_flight_rank{rank}.json")
+                return recorder.dump(path)
 
             # HOROVOD_STALL_CHECK_DISABLE silences the warning AND
             # shutdown tiers, but a configured collective deadline still
@@ -71,7 +112,7 @@ class GlobalState:
                                   else cfg.stall_shutdown_seconds),
                 kv=kv, rank=self.backend.rank(), size=self.backend.size(),
                 collective_deadline=cfg.collective_deadline,
-                escalate=_escalate)
+                escalate=_escalate, flight_dump=_flight_dump)
         # metrics emitter (horovod_tpu/metrics.py): one thread, three sinks
         # — JSONL file, rendezvous-KV publish (feeds the cluster-aggregated
         # GET /metrics on the runner server), Chrome-trace counter tracks
@@ -146,10 +187,15 @@ class GlobalState:
         engine = self.engine
         timeline = self.timeline
         stall = self.stall_inspector
+        tracer = self.trace_recorder
 
         def on_enqueue(name, kind, nbytes):
             if timeline is not None:
-                timeline.record_enqueue(name, kind, nbytes)
+                # tag the local span with the cross-rank correlation id the
+                # engine just stamped (trace.py), so this timeline joins
+                # against the merged cluster trace
+                corr = tracer.live_corr(name) if tracer is not None else None
+                timeline.record_enqueue(name, kind, nbytes, corr=corr)
             if stall is not None:
                 stall.record_enqueue(name)
 
@@ -186,6 +232,12 @@ class GlobalState:
                 # and a last KV publish for the scrape endpoint
                 self.metrics_emitter.stop(final_flush=True)
                 self.metrics_emitter = None
+            if self.trace_publisher is not None:
+                # final segment publish so short-lived jobs still appear
+                # in the merged GET /trace
+                self.trace_publisher.stop(final_flush=True)
+                self.trace_publisher = None
+            self.trace_recorder = None
             if self.timeline is not None:
                 self.timeline.stop()
                 self.timeline = None

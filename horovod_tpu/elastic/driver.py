@@ -290,6 +290,14 @@ class ElasticDriver:
             self._assignments = assignments
             self._pending_resume = False
             self._rendezvous.init(assignments)
+            # a new world re-numbers ranks: published trace segments from
+            # the previous world would merge two different processes under
+            # one pid in GET /trace — drop them (segments re-publish on
+            # each worker's next trace tick; correlation ids also carry
+            # the world version, so even a racing stale publish stays
+            # distinguishable)
+            if hasattr(self._rendezvous, "clear_scope"):
+                self._rendezvous.clear_scope("trace")
             self._registry.reset(
                 [f"{s.hostname}:{s.local_rank}" for s in assignments])
             pending = [s for s in assignments

@@ -111,6 +111,11 @@ FAULT_SPECS: Dict[str, str] = {
     "stall.publish": "Inside the stall inspector's KV liveness publish",
     # metrics.py
     "metrics.publish": "Inside the metrics snapshot KV publish",
+    # trace.py
+    "trace.publish": "Inside the trace-segment KV publish "
+                     "(trace.publish_segment); drop() models a silently "
+                     "lost segment — the merged /trace must degrade "
+                     "gracefully, never fail",
 }
 
 

@@ -89,6 +89,17 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
     "hvd_tpu_sharded_step_seconds": (
         "histogram", "Wall time of one sharded optimizer step's dispatch "
                      "phase (pack + rs->update->ag launch)"),
+    # trace.py (cross-rank collective tracing)
+    "hvd_tpu_trace_publish_failures_total": (
+        "counter", "Trace-segment KV publishes that failed"),
+    "hvd_tpu_collective_skew_seconds": (
+        "histogram", "Cross-rank arrival skew per correlated collective "
+                     "(last-arrival minus first-arrival rank), by op kind "
+                     "— observed by the trace merger when GET /trace is "
+                     "served"),
+    "hvd_tpu_straggler_rank": (
+        "gauge", "Rank most often last to arrive over the correlated "
+                 "collectives in the merged trace window"),
     # stall_inspector.py
     "hvd_tpu_stall_publish_failures_total": (
         "counter", "Stall-inspector KV liveness publishes that failed"),

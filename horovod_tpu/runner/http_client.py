@@ -62,6 +62,24 @@ def read_data_from_kvstore(addr: str, port: int, scope: str, key: str,
         f"after {timeout}s: {last_err}")
 
 
+def fetch_server_clock(addr: str, port: int,
+                       timeout: float = 5.0) -> tuple:
+    """One clock-alignment beacon against the KV server's ``GET /clock``:
+    returns ``(local_monotonic_midpoint, server_wall_ts, rtt)``. The
+    server stamps its wall clock while the request is in flight, so
+    pairing it with the local monotonic midpoint bounds the offset error
+    by rtt/2 — the same server-stamped-clock discipline the stall
+    inspector's skew-safe heartbeat staleness uses. The trace merger picks
+    each rank's minimum-rtt beacon (trace.clock_offset)."""
+    import json
+    t0 = time.monotonic()
+    with urllib.request.urlopen(f"http://{addr}:{port}/clock",
+                                timeout=timeout) as resp:
+        payload = json.loads(resp.read())
+    t1 = time.monotonic()
+    return ((t0 + t1) / 2.0, float(payload["ts"]), t1 - t0)
+
+
 def put_data_into_kvstore(addr: str, port: int, scope: str, key: str,
                           value: bytes, timeout: float = 60.0,
                           retries: int = 3,

@@ -34,6 +34,12 @@ PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 # KV scope workers publish snapshots under (== metrics.METRICS_KV_SCOPE;
 # kept literal so the server module stays importable standalone)
 METRICS_SCOPE = "metrics"
+# KV scope workers publish trace segments under (== trace.TRACE_KV_SCOPE);
+# GET /trace (empty key) serves the merged cluster Chrome trace
+TRACE_SCOPE = "trace"
+# GET /clock serves the server's wall clock — the clock-alignment beacon
+# every rank pairs with its local monotonic clock (trace.py)
+CLOCK_SCOPE = "clock"
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -60,6 +66,8 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.send_response(OK)
         if scope == METRICS_SCOPE and not key:
             self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+        elif (scope in (TRACE_SCOPE, CLOCK_SCOPE)) and not key:
+            self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(value)))
         self.end_headers()
         self.wfile.write(value)
@@ -101,6 +109,14 @@ class KVStoreServer(ThreadingHTTPServer):
         self._lock = threading.Lock()
         self._store: Dict[str, Dict[str, bytes]] = collections.defaultdict(dict)
         self._thread: Optional[threading.Thread] = None
+        # per-name highest observed (world_version, seq) by the /trace
+        # skew observation: repeat scrapes over the same ring snapshot
+        # must not re-observe the same collectives into the histogram.
+        # Guarded by its own lock (renders can be slow — don't block PUTs
+        # on self._lock, but two racing GET /trace must not both observe
+        # the same collectives)
+        self._skew_watermark: Dict[str, tuple] = {}
+        self._trace_render_lock = threading.Lock()
 
     # -- handler callbacks --------------------------------------------------
 
@@ -112,6 +128,14 @@ class KVStoreServer(ThreadingHTTPServer):
             return None
         if scope == METRICS_SCOPE and not key:
             return self._render_metrics()
+        if scope == TRACE_SCOPE and not key:
+            return self._render_trace()
+        if scope == CLOCK_SCOPE and not key:
+            # server-stamped wall clock: the clock-alignment beacon source
+            # (trace.py). Stamped as late as possible so the client's
+            # rtt/2 midpoint estimate stays tight.
+            import time
+            return json.dumps({"ts": time.time()}).encode()
         with self._lock:
             return self._store.get(scope, {}).get(key)
 
@@ -135,6 +159,28 @@ class KVStoreServer(ThreadingHTTPServer):
                                        "events")):
             snaps.setdefault("driver", local)
         return render_prometheus_cluster(snaps).encode()
+
+    def _render_trace(self) -> bytes:
+        """The merged cluster Chrome trace: every worker's published
+        ``trace/<rank>`` segment, pid-remapped to rank and clock-aligned
+        (horovod_tpu/trace.py). Missing or unparseable rank segments thin
+        the trace instead of failing the endpoint; per-collective arrival
+        skew is observed into the server-local registry on the way so it
+        rides the ``GET /metrics`` scrape (rank="driver")."""
+        from ..metrics import registry
+        from ..trace import render_cluster_trace
+        with self._lock:
+            payloads = dict(self._store.get(TRACE_SCOPE, {}))
+        with self._trace_render_lock:
+            return render_cluster_trace(payloads, reg=registry(),
+                                        watermark=self._skew_watermark)
+
+    def clear_scope(self, scope: str):
+        """Drop every key under one scope (the elastic driver clears stale
+        ``trace/<rank>`` segments when a new world activates, so a merged
+        trace never mixes ranks from two worlds)."""
+        with self._lock:
+            self._store.pop(scope, None)
 
     def handle_put(self, scope: str, key: str, value: bytes, handler) -> int:
         # drop() acks 200 without storing — the silent-loss fault the

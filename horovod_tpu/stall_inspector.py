@@ -52,11 +52,18 @@ class StallInspector:
                  kv: Optional[Tuple[str, int]] = None,
                  rank: int = 0, size: int = 1,
                  collective_deadline: float = 0.0,
-                 escalate: Optional[Callable[[Exception], None]] = None):
+                 escalate: Optional[Callable[[Exception], None]] = None,
+                 flight_dump: Optional[Callable[[], Optional[str]]] = None):
         self.warning_seconds = warning_seconds
         self.shutdown_seconds = shutdown_seconds
         self.collective_deadline = collective_deadline
         self.escalate = escalate
+        # flight recorder (horovod_tpu/trace.py, wired by GlobalState):
+        # called exactly once, before the escalate hook poisons the engine
+        # (and before a shutdown-tier process abort), to dump the last-N
+        # in-memory trace spans to disk — a hang post-mortem always has
+        # the spans that led into it.
+        self.flight_dump = flight_dump
         if collective_deadline > 0:
             # the watchdog must FIRE within the deadline, so the tick must
             # undercut it; disabled-deadline jobs keep the coarse cadence
@@ -271,6 +278,7 @@ class StallInspector:
             return
         self._escalated = True
         self._m_escalations.inc()
+        self._run_flight_dump()
         err = HorovodInternalError(
             f"collective watchdog: {reason} (HOROVOD_TPU_COLLECTIVE_"
             f"DEADLINE={self.collective_deadline:g}s). Aborting local "
@@ -284,6 +292,19 @@ class StallInspector:
                 logger.warning("watchdog escalation hook failed: %s", e)
         from . import faults
         faults.break_hangs(err)
+
+    def _run_flight_dump(self):
+        """Best-effort flight-recorder dump (never blocks an escalation on
+        a disk failure)."""
+        if self.flight_dump is None:
+            return
+        try:
+            path = self.flight_dump()
+            if path:
+                logger.warning("flight recorder: trace ring dumped to %s",
+                               path)
+        except Exception as e:
+            logger.warning("flight-recorder dump failed: %s", e)
 
     def _check_collective_deadline(self, items, now: float):
         """Local leg: an op enqueued but not completed past the deadline is
@@ -360,6 +381,7 @@ class StallInspector:
                 if self.shutdown_seconds > 0 and age > self.shutdown_seconds:
                     logger.error("Stalled tensor %s exceeded shutdown threshold "
                                  "%.0f s; aborting.", name, self.shutdown_seconds)
+                    self._run_flight_dump()
                     os._exit(64)
             if self.collective_deadline > 0 and not self._escalated:
                 self._check_collective_deadline(items, now)

@@ -17,12 +17,15 @@ both).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import queue
 import re
 import threading
 import time
 from typing import Optional
+
+logger = logging.getLogger("horovod_tpu")
 
 _AUTO_NAME_RE = re.compile(r"\.noname\.\d+$")
 _MAX_TIDS = 4096
@@ -39,13 +42,20 @@ def _native_enabled() -> bool:
 
 
 class Timeline:
-    def __init__(self, path: str, mark_cycles: bool = False):
+    def __init__(self, path: str, mark_cycles: bool = False, pid: int = 0):
         self.path = path
         self.mark_cycles = mark_cycles
+        # Chrome-trace pid for every Python-writer event: the rank, so two
+        # ranks' timelines can be overlaid (the native writer predates the
+        # cross-rank work and still stamps pid 0; horovod_tpu/trace.py's
+        # merger remaps pids from the published segments instead).
+        self.pid = pid
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._thread: Optional[threading.Thread] = None
         self._running = False
         self._start = time.monotonic()
+        # outstanding tensor names (enqueue seen, done not yet): the guard
+        # that keeps a stray record_done from emitting an unbalanced "E"
         self._pending = {}
         self._tids = {}
         self._next_tid = 1
@@ -114,24 +124,43 @@ class Timeline:
             self._tids[key] = tid
         return tid
 
-    def record_enqueue(self, name: str, kind: str, nbytes: int):
+    def record_enqueue(self, name: str, kind: str, nbytes: int,
+                       corr: Optional[str] = None):
+        """Open the tensor's span. ``corr`` is the cross-rank correlation
+        id stamped by the engine (horovod_tpu/trace.py) — tagged into the
+        span args so a local timeline joins against the merged trace."""
+        self._pending[name] = corr
+        args = {"tensor": name, "bytes": nbytes}
+        if corr is not None:
+            args["corr"] = corr
         if self._native is not None:
-            args = json.dumps({"tensor": name, "bytes": nbytes})
             self._native.hvd_timeline_event(
                 b"B", kind.upper().encode(), int(self._ts_us()), 0,
-                self._tid(name), args.encode())
+                self._tid(name), json.dumps(args).encode())
             return
         self._q.put({"name": kind.upper(), "ph": "B", "ts": self._ts_us(),
-                     "pid": 0, "tid": self._tid(name),
-                     "args": {"tensor": name, "bytes": nbytes}})
+                     "pid": self.pid, "tid": self._tid(name), "args": args})
 
     def record_done(self, name: str):
-        if self._native is not None:
-            self._native.hvd_timeline_event(
-                b"E", b"", int(self._ts_us()), 0, self._tid(name), None)
+        if name not in self._pending:
+            # a done for a name that was never enqueued (e.g. a handle
+            # completed after an elastic reset rebuilt the timeline) would
+            # emit an unbalanced "E" and corrupt the trace: drop it.
+            logger.debug("timeline: done for un-enqueued name %r dropped",
+                         name)
             return
-        self._q.put({"name": "", "ph": "E", "ts": self._ts_us(),
-                     "pid": 0, "tid": self._tid(name)})
+        corr = self._pending.pop(name, None)
+        if self._native is not None:
+            args = (json.dumps({"corr": corr}).encode()
+                    if corr is not None else None)
+            self._native.hvd_timeline_event(
+                b"E", b"", int(self._ts_us()), 0, self._tid(name), args)
+            return
+        ev = {"name": "", "ph": "E", "ts": self._ts_us(),
+              "pid": self.pid, "tid": self._tid(name)}
+        if corr is not None:
+            ev["args"] = {"corr": corr}
+        self._q.put(ev)
 
     def record_activity(self, name: str, activity: str, dur_us: float):
         if self._native is not None:
@@ -140,7 +169,7 @@ class Timeline:
                 int(dur_us), self._tid(name), None)
             return
         self._q.put({"name": activity, "ph": "X", "ts": self._ts_us() - dur_us,
-                     "dur": dur_us, "pid": 0, "tid": self._tid(name)})
+                     "dur": dur_us, "pid": self.pid, "tid": self._tid(name)})
 
     def record_replay(self, event: str, detail: str = ""):
         """Step-capture replay lifecycle instants (core/replay.py):
@@ -152,7 +181,7 @@ class Timeline:
             self._native.hvd_timeline_event(
                 b"i", name.encode(), int(self._ts_us()), 0, 0, args)
             return
-        ev = {"name": name, "ph": "i", "ts": self._ts_us(), "pid": 0,
+        ev = {"name": name, "ph": "i", "ts": self._ts_us(), "pid": self.pid,
               "tid": 0, "s": "p"}
         if detail:
             ev["args"] = {"detail": detail}
@@ -169,7 +198,7 @@ class Timeline:
                 json.dumps(values).encode())
             return
         self._q.put({"name": name, "ph": "C", "ts": self._ts_us(),
-                     "pid": 0, "tid": 0, "args": dict(values)})
+                     "pid": self.pid, "tid": 0, "args": dict(values)})
 
     def mark_cycle(self):
         if not self.mark_cycles:
@@ -179,16 +208,28 @@ class Timeline:
                 b"i", b"CYCLE", int(self._ts_us()), 0, 0, None)
             return
         self._q.put({"name": "CYCLE", "ph": "i", "ts": self._ts_us(),
-                     "pid": 0, "tid": 0, "s": "g"})
+                     "pid": self.pid, "tid": 0, "s": "g"})
 
     # -- writer thread -----------------------------------------------------
 
     def _writer(self):
+        # Write-then-seal (crash tolerance): after EVERY event the closing
+        # "]" is re-written and flushed, then overwritten in place by the
+        # next event. A rank killed mid-stream leaves a file whose last
+        # flushed state is complete, valid Chrome-trace JSON — where the
+        # old close-on-clean-stop form left an unparseable fragment. (A
+        # kill between flushes can still leave partial buffered bytes
+        # after the last seal; trace.load_trace_events recovers the valid
+        # prefix of such files.) Each event is one seek + two small writes
+        # — negligible next to the json.dump it already paid.
         d = os.path.dirname(self.path)
         if d:
             os.makedirs(d, exist_ok=True)
         with open(self.path, "w") as f:
-            f.write("[\n")
+            f.write("[")
+            seal_pos = f.tell()
+            f.write("\n]\n")
+            f.flush()
             first = True
             while True:
                 try:
@@ -199,9 +240,10 @@ class Timeline:
                     continue
                 if ev is None:
                     break
-                if not first:
-                    f.write(",\n")
+                f.seek(seal_pos)
+                f.write("\n" if first else ",\n")
                 json.dump(ev, f)
-                first = False
+                seal_pos = f.tell()
+                f.write("\n]\n")
                 f.flush()
-            f.write("\n]\n")
+                first = False

@@ -71,14 +71,18 @@ class _CountingState(hvd.elastic.ObjectState):
         super().restore()
 
 
-def test_watchdog_hang_recovery_end_to_end(monkeypatch):
+def test_watchdog_hang_recovery_end_to_end(monkeypatch, tmp_path):
     """A peer's collective stops completing (modeled by a one-shot hang at
     the dispatch edge, where the op already sits in the stall inspector's
     outstanding table). The watchdog must fire within
     HOROVOD_TPU_COLLECTIVE_DEADLINE, surface HorovodInternalError, and the
-    elastic run-loop must restore the last commit and finish training."""
+    elastic run-loop must restore the last commit and finish training.
+    The escalation must also dump the flight-recorder trace ring (ISSUE 5)
+    BEFORE poisoning the engine, so the hang post-mortem has the spans
+    that led into it."""
     deadline = 1.0
     monkeypatch.setenv("HOROVOD_TPU_COLLECTIVE_DEADLINE", str(deadline))
+    monkeypatch.setenv("HOROVOD_TPU_TRACE_DUMP_DIR", str(tmp_path))
     monkeypatch.delenv("HOROVOD_STALL_CHECK_DISABLE", raising=False)
     hvd.shutdown()
     hvd.init()
@@ -116,6 +120,17 @@ def test_watchdog_hang_recovery_end_to_end(monkeypatch):
         assert reg.counter("hvd_tpu_elastic_recoveries_total").value(
             kind="internal") == rec_before + 1
         assert faults.hits("engine.dispatch") == 1
+        # flight recorder (ISSUE 5 acceptance): the escalation dumped the
+        # in-memory trace ring to disk, and the dump holds the spans that
+        # led into the hang — including the wedged op, sealed open.
+        dump = tmp_path / f"hvd_tpu_flight_rank{hvd.rank()}.json"
+        assert dump.exists(), "watchdog escalation wrote no flight dump"
+        with open(dump) as f:
+            flight = json.load(f)
+        assert flight["otherData"]["flight_recorder"] is True
+        spans = [e for e in flight["traceEvents"] if e.get("ph") == "B"]
+        assert any(e["args"].get("tensor", "").startswith("chaos.b")
+                   for e in spans), spans
     finally:
         faults.disarm()
         hvd.shutdown()

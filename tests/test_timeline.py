@@ -100,6 +100,98 @@ def test_tid_overflow_hashes_onto_reserved_pool(tmp_path, monkeypatch):
     assert 0 not in per_tid
 
 
+def test_record_done_without_enqueue_is_dropped(tmp_path, monkeypatch):
+    """ISSUE 5 satellite: a done for a name that was never enqueued used
+    to emit an unbalanced "E" event — it must be guarded (debug-log +
+    drop) so merged traces never contain dangling ends."""
+    monkeypatch.setenv("HOROVOD_TIMELINE_NATIVE", "0")
+    p = str(tmp_path / "guard.json")
+    tl = Timeline(p)
+    tl.start()
+    tl.record_done("never.enqueued")          # stray: dropped
+    tl.record_enqueue("real", "allreduce", 8)
+    tl.record_done("real")
+    tl.record_done("real")                    # double-done: dropped too
+    tl.stop()
+    events = _load_events(p)
+    assert [e["ph"] for e in events] == ["B", "E"]
+
+
+def test_pid_and_correlation_tagging(tmp_path, monkeypatch):
+    """The Python writer stamps the configured pid (the rank) and tags
+    spans with the engine's cross-rank correlation id, so a local timeline
+    joins against the merged /trace."""
+    monkeypatch.setenv("HOROVOD_TIMELINE_NATIVE", "0")
+    p = str(tmp_path / "corr.json")
+    tl = Timeline(p, pid=7)
+    tl.start()
+    tl.record_enqueue("g", "allreduce", 8, corr="g#0#1")
+    tl.record_done("g")
+    tl.stop()
+    b, e = _load_events(p)
+    assert b["pid"] == e["pid"] == 7
+    assert b["args"]["corr"] == "g#0#1"
+    assert e["args"]["corr"] == "g#0#1"
+
+
+def test_file_is_valid_while_writer_is_live(tmp_path, monkeypatch):
+    """Write-then-seal: the file parses as complete JSON after every
+    flushed event, not only after a clean stop."""
+    import time
+    monkeypatch.setenv("HOROVOD_TIMELINE_NATIVE", "0")
+    p = str(tmp_path / "live.json")
+    tl = Timeline(p)
+    tl.start()
+    try:
+        tl.record_enqueue("a", "allreduce", 1)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            try:
+                if _load_events(p):
+                    break
+            except (ValueError, FileNotFoundError):
+                pass
+            time.sleep(0.02)
+        events = _load_events(p)
+        assert [e["ph"] for e in events] == ["B"]
+    finally:
+        tl.stop()
+
+
+def test_writer_killed_mid_stream_leaves_loadable_file(tmp_path):
+    """ISSUE 5 satellite regression: a rank killed mid-stream (os._exit —
+    no atexit, no writer stop) must leave a timeline every complete event
+    of which is recoverable. With write-then-seal the last flushed state
+    is even plain-json.load()-able; the tolerant loader covers the
+    partial-buffer tail case."""
+    import subprocess
+    import sys
+    p = str(tmp_path / "killed.json")
+    script = f"""
+import os, time
+os.environ["HOROVOD_TIMELINE_NATIVE"] = "0"
+from horovod_tpu.timeline import Timeline
+tl = Timeline({p!r})
+tl.start()
+for i in range(50):
+    tl.record_enqueue(f"t{{i}}", "allreduce", 64)
+    tl.record_done(f"t{{i}}")
+time.sleep(0.5)        # let the writer drain + flush
+os._exit(1)            # crash: no stop(), no atexit
+"""
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    from horovod_tpu.trace import load_trace_file
+    events = load_trace_file(p)
+    assert len(events) == 100, f"recovered {len(events)} of 100 events"
+    phases = [e["ph"] for e in events]
+    assert phases.count("B") == phases.count("E") == 50
+    # ...and the crash-tolerant format is ALSO plain valid JSON up to the
+    # last flushed seal
+    assert isinstance(json.load(open(p)), list)
+
+
 def test_native_build_and_introspection():
     assert native.built() == (native.load() is not None)
     if native.load() is not None:

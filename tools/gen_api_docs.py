@@ -77,6 +77,12 @@ SECTIONS = [
     ("Callbacks", "horovod_tpu.callbacks", []),
     ("Observability", "horovod_tpu.timeline", []),
     ("", "horovod_tpu.stall_inspector", []),
+    ("Cross-rank tracing", "horovod_tpu.trace", [
+        "TraceRecorder", "TracePublisher", "publish_segment",
+        "merge_segments", "collective_skew", "modal_straggler",
+        "observe_skew",
+        "render_cluster_trace", "clock_offset", "load_trace_events",
+        "load_trace_file", "make_corr", "parse_corr"]),
     ("Autotuning", "horovod_tpu.autotune.parameter_manager", []),
 ]
 

@@ -1,0 +1,335 @@
+"""Offline straggler / critical-path analyzer for merged cluster traces.
+
+Input: a Chrome-trace JSON file as served by ``GET /trace`` on the
+rendezvous/KV server (object form with ``traceEvents``), a bare event
+array (e.g. a per-rank timeline or flight-recorder dump), or a
+crash-truncated file — loading goes through the tolerant
+``horovod_tpu.trace.load_trace_events``.
+
+Report (``python tools/trace_report.py TRACE.json``):
+
+- **per-collective arrival skew** — for every correlation id seen on >= 2
+  ranks, the gap between the first-arrival and last-arrival rank,
+  aggregated per op kind (count / mean / p50 / max);
+- **top-straggler ranking** — ranks ordered by how often they arrived
+  last, with their mean lateness;
+- **per-step wire-vs-gap breakdown** — per rank, mean STEP span time
+  split into dispatch (wire) time vs everything else (gap);
+- **critical-path estimate** — dispatch time plus the arrival skew the
+  whole world waited out, attributed to the rank that caused each wait.
+
+Schema self-check (``--check``, the ``check_metric_names.py`` /
+``check_fault_names.py`` lint pattern, run from a tier-1 test): validates
+event structure, B/E balance per (pid, tid), correlation-id format, and
+the once-per-phase-per-rank invariant. Exit code 0 means clean.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+VALID_PHASES = ("B", "E", "X", "i", "C", "M", "b", "e")
+
+
+# ---------------------------------------------------------------------------
+# analysis
+# ---------------------------------------------------------------------------
+
+def _corr_of(ev: dict) -> Optional[str]:
+    args = ev.get("args")
+    if isinstance(args, dict):
+        c = args.get("corr")
+        if isinstance(c, str):
+            return c
+    return None
+
+
+def arrival_skew(events: List[dict]) -> Dict[str, dict]:
+    """Per-correlation-id arrival skew from the merged "B" (enqueue)
+    events: ``corr -> {kind, arrivals: {pid: ts_us}, first, last,
+    skew_us}``. Only ids seen on >= 2 pids count."""
+    arrivals: Dict[str, dict] = {}
+    for ev in events:
+        if ev.get("ph") != "B":
+            continue
+        corr = _corr_of(ev)
+        if corr is None:
+            continue
+        ent = arrivals.setdefault(corr, {"kind": ev.get("name", ""),
+                                         "arrivals": {}})
+        ent["arrivals"].setdefault(int(ev.get("pid", 0)), float(ev["ts"]))
+    out: Dict[str, dict] = {}
+    for corr, ent in arrivals.items():
+        ranks = ent["arrivals"]
+        if len(ranks) < 2:
+            continue
+        first = min(ranks, key=ranks.get)
+        last = max(ranks, key=ranks.get)
+        out[corr] = {"kind": ent["kind"], "arrivals": ranks,
+                     "first": first, "last": last,
+                     "skew_us": ranks[last] - ranks[first]}
+    return out
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(int(q * (len(sorted_vals) - 1)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def skew_by_kind(skews: Dict[str, dict]) -> Dict[str, dict]:
+    by_kind: Dict[str, List[float]] = {}
+    for ent in skews.values():
+        by_kind.setdefault(ent["kind"], []).append(ent["skew_us"])
+    out = {}
+    for kind, vals in by_kind.items():
+        vals.sort()
+        out[kind] = {"count": len(vals),
+                     "mean_us": sum(vals) / len(vals),
+                     "p50_us": _percentile(vals, 0.5),
+                     "max_us": vals[-1]}
+    return out
+
+
+def straggler_ranking(skews: Dict[str, dict]) -> List[dict]:
+    """Ranks ordered by how often they arrived last (ties by total
+    lateness): ``[{rank, last_count, total_late_us, mean_late_us}]``."""
+    per_rank: Dict[int, dict] = {}
+    for ent in skews.values():
+        r = ent["last"]
+        acc = per_rank.setdefault(r, {"rank": r, "last_count": 0,
+                                      "total_late_us": 0.0})
+        acc["last_count"] += 1
+        acc["total_late_us"] += ent["skew_us"]
+    out = sorted(per_rank.values(),
+                 key=lambda a: (-a["last_count"], -a["total_late_us"]))
+    for acc in out:
+        acc["mean_late_us"] = acc["total_late_us"] / acc["last_count"]
+    return out
+
+
+def wire_vs_gap(events: List[dict]) -> Dict[int, dict]:
+    """Per rank: mean per-step breakdown of STEP span time into dispatch
+    ("wire", the X dispatch spans inside the step window) vs everything
+    else ("gap": host time, stragglers, input pipeline). Ranks without
+    STEP spans report totals over the whole trace instead."""
+    steps: Dict[int, List[Tuple[float, float]]] = {}
+    dispatch: Dict[int, List[Tuple[float, float]]] = {}
+    span: Dict[int, Tuple[float, float]] = {}
+    for ev in events:
+        pid = int(ev.get("pid", 0))
+        if ev.get("ph") != "X":
+            if ev.get("ph") in ("B", "E"):
+                t = float(ev.get("ts", 0.0))
+                lo, hi = span.get(pid, (t, t))
+                span[pid] = (min(lo, t), max(hi, t))
+            continue
+        t0 = float(ev.get("ts", 0.0))
+        dur = float(ev.get("dur", 0.0))
+        lo, hi = span.get(pid, (t0, t0 + dur))
+        span[pid] = (min(lo, t0), max(hi, t0 + dur))
+        if ev.get("name") == "STEP":
+            steps.setdefault(pid, []).append((t0, t0 + dur))
+        elif ev.get("cat") == "dispatch" or \
+                str(ev.get("name", "")).startswith("XLA_"):
+            dispatch.setdefault(pid, []).append((t0, t0 + dur))
+    out: Dict[int, dict] = {}
+    for pid in sorted(set(steps) | set(dispatch) | set(span)):
+        d = dispatch.get(pid, [])
+        st = steps.get(pid, [])
+        if st:
+            total = sum(b - a for a, b in st)
+            wire = sum(min(b, sb) - max(a, sa)
+                       for a, b in d for sa, sb in st
+                       if min(b, sb) > max(a, sa))
+            n = len(st)
+        else:
+            lo, hi = span.get(pid, (0.0, 0.0))
+            total = hi - lo
+            wire = sum(b - a for a, b in d)
+            n = 1 if total > 0 else 0
+        out[pid] = {"steps": len(st), "total_us": total,
+                    "wire_us": min(wire, total),
+                    "gap_us": max(total - wire, 0.0),
+                    "per_step_total_us": total / n if n else 0.0}
+    return out
+
+
+def critical_path(events: List[dict],
+                  skews: Dict[str, dict]) -> dict:
+    """A coarse critical-path estimate: total dispatch (wire) time plus
+    the arrival skew the world waited out per collective, attributed to
+    the last-arrival rank of each. ``{total_us, wire_us, wait_us,
+    wait_by_rank: {rank: us}}``."""
+    wire = sum(float(ev.get("dur", 0.0)) for ev in events
+               if ev.get("ph") == "X" and ev.get("cat") == "dispatch")
+    wait_by_rank: Dict[int, float] = {}
+    for ent in skews.values():
+        wait_by_rank[ent["last"]] = \
+            wait_by_rank.get(ent["last"], 0.0) + ent["skew_us"]
+    wait = sum(wait_by_rank.values())
+    return {"total_us": wire + wait, "wire_us": wire, "wait_us": wait,
+            "wait_by_rank": wait_by_rank}
+
+
+def analyze(events: List[dict]) -> dict:
+    """The full report as a plain dict (what ``main`` prints; tests and
+    notebooks call this directly)."""
+    skews = arrival_skew(events)
+    ranking = straggler_ranking(skews)
+    return {
+        "events": len(events),
+        "ranks": sorted({int(e.get("pid", 0)) for e in events
+                         if e.get("ph") in ("B", "E", "X")}),
+        "correlated_collectives": len(skews),
+        "skew_by_kind": skew_by_kind(skews),
+        "stragglers": ranking,
+        "top_straggler": ranking[0]["rank"] if ranking else None,
+        "wire_vs_gap": wire_vs_gap(events),
+        "critical_path": critical_path(events, skews),
+    }
+
+
+# ---------------------------------------------------------------------------
+# --check: trace schema + correlation-invariant lint
+# ---------------------------------------------------------------------------
+
+def check_events(events: List[dict]) -> List[str]:
+    """Validate the merged-trace schema; returns error strings (empty =
+    clean):
+
+    - every event is an object with a known ``ph``, a numeric ``ts``
+      (metadata excepted) and an integer ``pid``;
+    - "B"/"E" balance per (pid, tid), with no dangling end;
+    - every correlation id parses as ``name#world_version#seq``;
+    - per (pid, corr): at most one enqueue (B) and one complete (E) —
+      the exactly-once-per-phase invariant the merger guarantees."""
+    from horovod_tpu.trace import parse_corr
+    errors: List[str] = []
+    depth: Dict[Tuple[int, int], int] = {}
+    seen: Dict[Tuple[int, str], Dict[str, int]] = {}
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            errors.append(f"event {i}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in VALID_PHASES:
+            errors.append(f"event {i}: unknown phase {ph!r}")
+            continue
+        if ph != "M" and not isinstance(ev.get("ts"), (int, float)):
+            errors.append(f"event {i}: missing numeric ts")
+        if not isinstance(ev.get("pid"), int):
+            errors.append(f"event {i}: missing integer pid")
+            continue
+        key = (ev.get("pid"), ev.get("tid", 0))
+        if ph == "B":
+            depth[key] = depth.get(key, 0) + 1
+        elif ph == "E":
+            if depth.get(key, 0) <= 0:
+                errors.append(f"event {i}: dangling E on pid/tid {key}")
+            else:
+                depth[key] -= 1
+        corr = _corr_of(ev)
+        if corr is not None:
+            try:
+                parse_corr(corr)
+            except (ValueError, TypeError):
+                errors.append(f"event {i}: malformed correlation id "
+                              f"{corr!r}")
+                continue
+            if ph in ("B", "E"):
+                phases = seen.setdefault((ev["pid"], corr), {})
+                phases[ph] = phases.get(ph, 0) + 1
+                if phases[ph] > 1:
+                    errors.append(
+                        f"event {i}: correlation id {corr!r} appears "
+                        f"{phases[ph]}x in phase {ph} on pid {ev['pid']}")
+    for key, d in depth.items():
+        if d != 0:
+            errors.append(f"pid/tid {key}: {d} unclosed B span(s)")
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _fmt_us(us: float) -> str:
+    return f"{us / 1e3:.2f} ms" if us >= 1e3 else f"{us:.0f} us"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        description="Straggler / critical-path report over a merged "
+                    "cluster trace (GET /trace output)")
+    p.add_argument("trace", help="trace JSON file (object or array form; "
+                                 "truncated files are recovered)")
+    p.add_argument("--check", action="store_true",
+                   help="validate the event schema and correlation-id "
+                        "invariants instead of reporting")
+    p.add_argument("--top", type=int, default=5,
+                   help="stragglers to list (default 5)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the report as JSON")
+    args = p.parse_args(argv)
+
+    from horovod_tpu.trace import load_trace_file
+    events = load_trace_file(args.trace)
+    if args.check:
+        errors = check_events(events)
+        if errors:
+            print(f"{len(errors)} trace schema error(s):")
+            for e in errors[:50]:
+                print(f"  - {e}")
+            return 1
+        print(f"{len(events)} events OK (schema, B/E balance, "
+              f"correlation ids once per phase per rank)")
+        return 0
+
+    rep = analyze(events)
+    if args.json:
+        print(json.dumps(rep, indent=2, sort_keys=True))
+        return 0
+    print(f"events: {rep['events']}   ranks: {rep['ranks']}   "
+          f"correlated collectives: {rep['correlated_collectives']}")
+    if rep["skew_by_kind"]:
+        print("\narrival skew by kind (first-arrival vs last-arrival rank):")
+        for kind, s in sorted(rep["skew_by_kind"].items()):
+            print(f"  {kind:<22} n={s['count']:<5} "
+                  f"mean={_fmt_us(s['mean_us']):<10} "
+                  f"p50={_fmt_us(s['p50_us']):<10} "
+                  f"max={_fmt_us(s['max_us'])}")
+    if rep["stragglers"]:
+        print(f"\ntop stragglers (of {rep['correlated_collectives']} "
+              f"correlated collectives):")
+        for acc in rep["stragglers"][:args.top]:
+            print(f"  rank {acc['rank']:<4} last-arrival "
+                  f"{acc['last_count']:>4}x   mean lateness "
+                  f"{_fmt_us(acc['mean_late_us'])}")
+    if rep["wire_vs_gap"]:
+        print("\nwire vs gap per rank:")
+        for pid, w in sorted(rep["wire_vs_gap"].items()):
+            print(f"  rank {pid:<4} steps={w['steps']:<4} "
+                  f"wire={_fmt_us(w['wire_us']):<10} "
+                  f"gap={_fmt_us(w['gap_us']):<10} "
+                  f"(per-step {_fmt_us(w['per_step_total_us'])})")
+    cp = rep["critical_path"]
+    print(f"\ncritical-path estimate: {_fmt_us(cp['total_us'])} "
+          f"(wire {_fmt_us(cp['wire_us'])} + straggler waits "
+          f"{_fmt_us(cp['wait_us'])})")
+    for r, us in sorted(cp["wait_by_rank"].items(),
+                        key=lambda kv: -kv[1]):
+        print(f"  waits attributed to rank {r}: {_fmt_us(us)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
