@@ -601,20 +601,26 @@ def bench_sp_ring():
         span = min(max(40, int(round(0.6 / est / 20.0)) * 20), 400)
         med, spread, n_used = _marginal_median(run, st0, 4, 4 + span,
                                                reps=5)
-        # Escalation (ISSUE 2 satellite: driver-run sp_ring spread hit
-        # 24.8% while the LM sections sat at ~1%): a high spread means the
-        # probe under-estimated the per-step cost and the span still sat
-        # at the noise floor — double it (same 20-step quantization, same
-        # cap) and keep the quieter reading.
-        if spread > 10.0 and span < 400:
-            med2, spread2, n2 = _marginal_median(
-                run, st0, 4, 4 + min(span * 2, 400), reps=5)
+        # Escalation (ISSUE 2 satellite; cap/retry raised in ISSUE 6 —
+        # BENCH_r05 still showed 24.8% spread at the doubled-once cap of
+        # 400): a high spread means the probe under-estimated the per-step
+        # cost and the span still sat at the noise floor. Keep doubling
+        # (same 20-step quantization) up to 800 steps / 2 extra attempts,
+        # keeping the quietest reading, and report how many escalations
+        # ran so the overlap deltas this round claims carry their own
+        # noise-band evidence.
+        escalations = 0
+        while spread > 10.0 and span < 800 and escalations < 2:
+            span = min(span * 2, 800)
+            escalations += 1
+            med2, spread2, n2 = _marginal_median(run, st0, 4, 4 + span,
+                                                 reps=5)
             if spread2 < spread:
-                return med2, spread2, n2
-        return med, spread, n_used
+                med, spread, n_used = med2, spread2, n2
+        return med, spread, n_used, escalations
 
     out = {}
-    dt, spread, n_used = measure(
+    dt, spread, n_used, escalations = measure(
         lambda q, k, v: ring_attention_p(q, k, v, "seq", n, causal=True))
     tflops = model_flops / dt / 1e12 / n
     out.update({
@@ -624,11 +630,12 @@ def bench_sp_ring():
         "sp_ring_config": f"B{B} T{T} H{H} D{D} causal ring{n}",
         "sp_ring_timing": f"scan_marginal_median_of_{n_used}",
         "sp_ring_spread_pct": round(spread, 1),
+        "sp_ring_escalations": escalations,
     })
     if n == 1:
         # single-shard flash (splash off): the ring path's kernel family
         with _splash_disabled():
-            fdt, fspread, _fn = measure(
+            fdt, fspread, _fn, _fe = measure(
                 lambda q, k, v: ring_attention_p(q, k, v, "seq", 1,
                                                  causal=True))
         ftf = model_flops / fdt / 1e12
@@ -638,7 +645,7 @@ def bench_sp_ring():
             "sp_ring_flash_spread_pct": round(fspread, 1),
         })
         # the multi-chip ring code path, driven honestly on one chip
-        pdt, pspread, _pn = measure(
+        pdt, pspread, _pn, _pe = measure(
             lambda q, k, v: ring_attention_p(q, k, v, "seq", 1, causal=True,
                                              layout="zigzag",
                                              force_ring=True))
@@ -858,6 +865,87 @@ def main():
         "fallbacks": eng.replay.fallbacks,
     }
 
+    # ---- comm/compute overlap attribution (ISSUE 6) -----------------------
+    # The same replayed eager step driven twice — overlap_pipeline "off"
+    # (the PR 1 serial chain) vs the configured/auto pipelined mode — with
+    # a fresh PR 5 trace ring swapped in around each measured window and
+    # pushed through tools/trace_report.py. wire_on_critical_path_pct is
+    # the acceptance bar (strictly lower with overlap on, same world, same
+    # model); overlap_efficiency_pct records how much of the collectives'
+    # in-flight time stayed off the critical path.
+    def _overlap_window(mode, steps=8):
+        import sys as _sys
+        tools_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "tools")
+        if tools_dir not in _sys.path:
+            _sys.path.insert(0, tools_dir)
+        from trace_report import overlap_report
+        from horovod_tpu.trace import TraceRecorder, merge_segments
+        prev_mode = eng.config.overlap_pipeline
+        eng.config.overlap_pipeline = mode
+        # suspend live autotune for the window: _pm_step re-applies the
+        # overlap_pipeline categorical every step and would overwrite the
+        # forced mode, corrupting the off-vs-on comparison
+        prev_pm = eng.parameter_manager
+        eng.parameter_manager = None
+        eng.replay.invalidate_all(f"bench overlap window ({mode})")
+        st = (params, batch_stats, eager_opt.init(params))
+        rec = TraceRecorder(rank=0, capacity=1 << 14)
+        old_trace = eng.trace
+        try:
+            # warmup outside the ring: replay arms (and the mode's programs
+            # compile) before the measured window starts
+            for _ in range(4):
+                out = eager_replay_step(*st, images, labels)
+                st = out[:-1]
+            _fetch_scalar(out[-1])
+            eng.trace = rec
+            for _ in range(steps):
+                out = eager_replay_step(*st, images, labels)
+                st = out[:-1]
+            _fetch_scalar(out[-1])
+        finally:
+            eng.trace = old_trace
+            eng.config.overlap_pipeline = prev_mode
+            eng.parameter_manager = prev_pm
+            eng.replay.invalidate_all("bench overlap window end")
+        return overlap_report(merge_segments({0: rec.segment(1 << 30)}))
+
+    try:
+        from horovod_tpu.core.engine import bucket_by_size
+        g_leaves = jax.tree_util.tree_leaves(params)  # grad-shape proxy
+        # the "on" window always measures a pipelined schedule (an operator
+        # who configured "off" still gets the off-vs-auto delta), so the
+        # reported mode must be resolved under the config the window ran
+        # with, not the operator's base setting
+        on_cfg = (eng.config.overlap_pipeline
+                  if eng.config.overlap_pipeline != "off" else "auto")
+        prev_cfg = eng.config.overlap_pipeline
+        eng.config.overlap_pipeline = on_cfg
+        try:
+            on_mode = eng._overlap_mode(
+                sum(l.nbytes for l in g_leaves),
+                len(bucket_by_size(g_leaves,
+                                   eng.config.fusion_threshold_bytes)))
+        finally:
+            eng.config.overlap_pipeline = prev_cfg
+        overlap_off = _overlap_window("off")
+        overlap_on = _overlap_window(on_cfg)
+        off_pct = overlap_off.get("wire_on_critical_path_pct")
+        on_pct = overlap_on.get("wire_on_critical_path_pct")
+        overlap_metrics = {
+            "overlap_pipeline_mode": on_mode,
+            "wire_on_critical_path_pct": on_pct,
+            "overlap_efficiency_pct":
+                overlap_on.get("overlap_efficiency_pct"),
+            "overlap_detail": {"off": overlap_off, "on": overlap_on},
+            "wire_cp_delta_pct": (round(off_pct - on_pct, 2)
+                                  if (off_pct is not None
+                                      and on_pct is not None) else None),
+        }
+    except Exception as e:
+        overlap_metrics = {"overlap_error": f"{type(e).__name__}: {e}"}
+
     # ---- eager ZeRO-1 sharded-optimizer path ------------------------------
     # Same measured loop, but the sync is reduce-scatter -> shard-local
     # update -> fused allgather through engine.sharded_step (auto-bracketed
@@ -879,9 +967,13 @@ def main():
                                                           params)
             return params, new_bs, opt_state, loss
 
+        m_pre = hvd_metrics.snapshot()
         sharded_dt, _, sharded_spread = _time_steps(
             eager_sharded_step, (params, batch_stats, zero_state),
             (images, labels), max(iters // 2, 4))
+        # snapshot before the dispatch probe: its extra step launches its
+        # own prefetch leg, which must not count against the measured loop
+        m_post = hvd_metrics.snapshot()
         sharded_disp = _engine_dispatches(
             eager_sharded_step, (params, batch_stats, zero_state))
         sharded_metrics = {
@@ -889,6 +981,11 @@ def main():
             "sharded_spread_pct": round(sharded_spread, 1),
             "sharded_vs_eager": round(eager_dt / sharded_dt, 3),
             "sharded_engine_dispatches_per_step": sharded_disp,
+            # ZeRO-1 all-gather prefetch legs launched under step tails
+            # during the measured loop (ISSUE 6 tentpole telemetry)
+            "sharded_prefetch_legs": int(
+                _ctr(m_post, "hvd_tpu_overlap_prefetch_total")
+                - _ctr(m_pre, "hvd_tpu_overlap_prefetch_total")),
         }
     except Exception as e:
         sharded_metrics = {"sharded_error": f"{type(e).__name__}: {e}"}
@@ -966,6 +1063,7 @@ def main():
         "eager_replay_vs_spmd": round(replay_img_s / spmd_img_s, 3),
         "replay_counters": replay_counters,
         "eager_gap_attribution": gap_attribution,
+        **overlap_metrics,
         **registry_telemetry,
         **sharded_metrics,
         "optimizer_state_bytes_per_chip": opt_state_bytes,

@@ -33,6 +33,12 @@ packing) that a static default cannot make per cluster:
   replicated update — the win depends on model size vs interconnect
   latency; the knob only steers optimizers whose state is created after
   the flip, since live shard shapes are frozen at init)
+- overlap_pipeline (ISSUE 6 bucket-pipelined comm/compute overlap:
+  serial vs pipelined collective schedule inside the fused step —
+  engine._pm_step maps the boolean onto the "off"/base string knob;
+  whether the pipelined schedule or the extra staged sub-launches pay
+  is a per-runtime dispatch-overhead-vs-wire-time fact, the same trade
+  step_replay tunes)
 
 Scoring: the interval between successive ``step_mark`` calls spans one
 full training step (mark fires at grouped-allreduce entry each step), so

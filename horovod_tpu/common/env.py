@@ -93,6 +93,33 @@ HOROVOD_TPU_METRICS_INTERVAL = "HOROVOD_TPU_METRICS_INTERVAL"
 # allreduce + replicated update (docs/sharded_optimizer.md). Also offered
 # as an autotune categorical; resolved once per optimizer at state init.
 HOROVOD_TPU_SHARD_OPTIMIZER = "HOROVOD_TPU_SHARD_OPTIMIZER"
+# bucket-pipelined comm/compute overlap (ISSUE 6): how the fused-step
+# builders order/split their per-bucket collectives. "off" = the PR 1
+# serial chain (pack/reduce/unpack interleaved, one monolithic launch);
+# "interleave" = one launch whose trace order is pack..., collective...,
+# unpack... (collectives back-to-back, async-overlappable); "staged" =
+# the replay engine splits the captured step into per-bucket sub-launches
+# so bucket i's collective is in flight while the host dispatches bucket
+# i+1's pack; "auto" (default) picks per (bytes, topology) — see
+# Engine._overlap_mode. Also an autotune categorical ("overlap_pipeline").
+HOROVOD_TPU_OVERLAP_PIPELINE = "HOROVOD_TPU_OVERLAP_PIPELINE"
+# auto mode switches from "interleave" to "staged" when a step's gradient
+# bytes reach this threshold (and the world has >1 rank)
+HOROVOD_TPU_OVERLAP_STAGE_BYTES = "HOROVOD_TPU_OVERLAP_STAGE_BYTES"
+# ZeRO-1 all-gather prefetch (ISSUE 6 tentpole): split the sharded step so
+# the parameter all-gather of step N+1's params launches as its own leg
+# under step N's tail, held by the engine across the step boundary and
+# invalidated on world-version bumps exactly like replay; =0 keeps the
+# fused rs->update->ag single launch. The split rides the STAGED schedule
+# only (forced, or auto-resolved staged) — under off/interleave the gather
+# stays inside the fused step program, the schedule replay sustains
+HOROVOD_TPU_ZERO1_PREFETCH = "HOROVOD_TPU_ZERO1_PREFETCH"
+# XLA latency-hiding scheduler as a supported knob (ISSUE 6 satellite,
+# folding tools/probe_resnet_overlap.py into the product): =1 appends
+# --xla_tpu_enable_latency_hiding_scheduler=true to XLA_FLAGS before the
+# first backend touch (loud WARNING + no-op if a jax backend already
+# exists — XLA parses XLA_FLAGS at backend init, not at import)
+HOROVOD_TPU_XLA_LHS = "HOROVOD_TPU_XLA_LHS"
 # fault injection (horovod_tpu/faults.py, which imports this constant):
 # a failpoint spec string; unset means every failpoint() marker is a
 # no-op. Parsed by faults._arm_from_env at import.
@@ -122,6 +149,9 @@ DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # operations.cc:432
 DEFAULT_CYCLE_TIME_MS = 5.0                        # operations.cc:440
 DEFAULT_CACHE_CAPACITY = 1024                      # operations.cc:449-456
 DEFAULT_STALL_WARNING_SECONDS = 60.0               # stall_inspector.h:75
+DEFAULT_OVERLAP_STAGE_BYTES = 8 * 1024 * 1024
+OVERLAP_PIPELINE_MODES = ("auto", "off", "interleave", "staged")
+_XLA_LHS_FLAG = "--xla_tpu_enable_latency_hiding_scheduler=true"
 
 
 def _get_bool(name: str, default: bool = False) -> bool:
@@ -149,6 +179,78 @@ def _get_float(name: str, default: float) -> float:
         return float(v)
     except ValueError:
         return default
+
+
+def _get_choice(name: str, default: str, choices) -> str:
+    v = os.environ.get(name)
+    if v is None or not v.strip():
+        return default
+    v = v.strip().lower()
+    if v not in choices:
+        import logging
+        logging.getLogger("horovod_tpu").warning(
+            "%s=%r is not one of %s; using %r", name, v, list(choices),
+            default)
+        return default
+    return v
+
+
+def apply_xla_lhs() -> bool:
+    """ISSUE 6 satellite: ``HOROVOD_TPU_XLA_LHS=1`` appends
+    ``--xla_tpu_enable_latency_hiding_scheduler=true`` to ``XLA_FLAGS``.
+
+    XLA parses ``XLA_FLAGS`` when the first backend client is created, so
+    this must run before the first backend touch — it is called from
+    ``horovod_tpu/__init__`` at import. If a jax backend already exists
+    the append would be silently ignored; that case gets a loud WARNING
+    and a no-op instead (the probe-documented footgun,
+    tools/probe_resnet_overlap.py: on remote-compile rigs use per-compile
+    ``compiler_options`` — this knob is for local-backend runs).
+
+    Returns True when the flag is (already or newly) in effect."""
+    import logging
+    import sys
+    if not _get_bool(HOROVOD_TPU_XLA_LHS):
+        return False
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_tpu_enable_latency_hiding_scheduler" in flags:
+        # user already set it — theirs wins; report whether it enables
+        return _XLA_LHS_FLAG in flags
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        # the backend registry is private and has moved between jax
+        # versions — probe the known locations, and degrade LOUDLY (not
+        # silently) when none resolves on a future jax
+        probed = False
+        backends = None
+        for parent in ("_src", "lib"):
+            try:
+                bridge = getattr(getattr(jax_mod, parent), "xla_bridge")
+                backends = bridge._backends
+                probed = True
+                break
+            except AttributeError:
+                continue
+            except Exception:
+                continue
+        if backends:
+            logging.getLogger("horovod_tpu").warning(
+                "HOROVOD_TPU_XLA_LHS=1 but a jax backend is already "
+                "initialized; XLA_FLAGS changes no longer take effect. "
+                "Set the env var before the first jax backend touch (or "
+                "use per-compile compiler_options on remote-compile "
+                "rigs). Ignoring the knob.")
+            return False
+        if not probed:
+            logging.getLogger("horovod_tpu").warning(
+                "HOROVOD_TPU_XLA_LHS=1: cannot tell whether a jax "
+                "backend is already initialized on this jax version; "
+                "appending the flag anyway. If any jax computation ran "
+                "before horovod_tpu was imported, XLA_FLAGS changes have "
+                "no effect — set the env var before the first backend "
+                "touch.")
+    os.environ["XLA_FLAGS"] = (flags + " " + _XLA_LHS_FLAG).strip()
+    return True
 
 
 @dataclass
@@ -182,6 +284,9 @@ class Config:
     step_replay: bool = True
     step_replay_warmup: int = 3
     shard_optimizer: bool = False
+    overlap_pipeline: str = "auto"
+    overlap_stage_bytes: int = DEFAULT_OVERLAP_STAGE_BYTES
+    zero1_prefetch: bool = True
     # NOTE: the HOROVOD_TPU_METRICS on/off switch is read by
     # metrics.metrics_enabled() (the registry outlives any Config); only
     # the emitter knobs live here
@@ -227,6 +332,12 @@ class Config:
             step_replay=_get_bool(HOROVOD_TPU_STEP_REPLAY, True),
             step_replay_warmup=_get_int(HOROVOD_TPU_STEP_REPLAY_WARMUP, 3),
             shard_optimizer=_get_bool(HOROVOD_TPU_SHARD_OPTIMIZER, False),
+            overlap_pipeline=_get_choice(
+                HOROVOD_TPU_OVERLAP_PIPELINE, "auto",
+                OVERLAP_PIPELINE_MODES),
+            overlap_stage_bytes=_get_int(HOROVOD_TPU_OVERLAP_STAGE_BYTES,
+                                         DEFAULT_OVERLAP_STAGE_BYTES),
+            zero1_prefetch=_get_bool(HOROVOD_TPU_ZERO1_PREFETCH, True),
             metrics_file=os.environ.get(HOROVOD_TPU_METRICS_FILE) or None,
             metrics_interval=_get_float(HOROVOD_TPU_METRICS_INTERVAL, 10.0),
             trace_enabled=_get_bool(HOROVOD_TPU_TRACE, True),

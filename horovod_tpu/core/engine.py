@@ -350,6 +350,27 @@ class Engine:
         # resolves keys here so a captured sharded step can fuse the update
         # into the single replayed launch
         self._sharded_updates: Dict[tuple, Callable] = {}
+        # Bucket-pipelined comm/compute overlap (ISSUE 6): the env-resolved
+        # base mode ("auto"/"interleave"/"staged"; an explicit "off" leaves
+        # "auto" as the base so the autotune categorical can still explore
+        # turning overlap ON), plus the held ZeRO-1 all-gather prefetch
+        # legs — update_key -> {"world_version"} — that ride across step
+        # boundaries and are invalidated on world-version bumps exactly
+        # like replay streams. The registry records only the accounting
+        # row: the leg's buffers stay alive through its consumers'
+        # dataflow futures, never through the engine.
+        self._overlap_base = (config.overlap_pipeline
+                              if config.overlap_pipeline != "off"
+                              else "auto")
+        self._zero1_prefetch: Dict[tuple, dict] = {}
+        self._in_step_bracket = False
+        self._overlap_step_noted = False
+        self._m_overlap_stages = _reg.counter(
+            "hvd_tpu_overlap_stage_launches_total")
+        self._m_overlap_steps = _reg.counter("hvd_tpu_overlap_steps_total")
+        self._m_prefetch = _reg.counter("hvd_tpu_overlap_prefetch_total")
+        self._m_prefetch_inval = _reg.counter(
+            "hvd_tpu_overlap_prefetch_invalidations_total")
         # step-capture replay (core/replay.py): records the dispatch stream
         # between step_begin/step_end and re-executes steady-state steps as
         # one fused launch
@@ -498,10 +519,13 @@ class Engine:
         are serviced by a single fused XLA launch (see core/replay.py)."""
         if self.trace is not None:
             self.trace.record_step(begin=True)
+        self._in_step_bracket = True
+        self._overlap_step_noted = False
         self._replay.step_begin()
 
     def step_end(self):
         self._replay.step_end()
+        self._in_step_bracket = False
         if self.trace is not None:
             self.trace.record_step(begin=False)
 
@@ -525,6 +549,94 @@ class Engine:
     @property
     def replay(self):
         return self._replay
+
+    # -- bucket-pipelined comm/compute overlap (ISSUE 6) -------------------
+
+    def _overlap_mode(self, nbytes: int = 0, n_buckets: int = 1,
+                      sharded: bool = False) -> str:
+        """Resolve the overlap pipeline mode for one step: "off" (the PR 1
+        serial chain), "interleave" (one launch, collectives traced
+        back-to-back), or "staged" (replay splits the step into per-bucket
+        sub-launches). "auto" picks per (bytes, topology): staged only
+        pays when there is more than one pipeline stage to overlap, the
+        payload is large enough that wire time dwarfs the extra dispatches
+        (``overlap_stage_bytes``), and the world actually has peers;
+        otherwise interleave — same launch count as serial, strictly freer
+        schedule.
+
+        One restriction applies to every resolution path (forced or auto):
+        in Join-live worlds "staged" demotes to "interleave" — a joined
+        peer's zero substitute services the advertisement with ONE grouped
+        program, and splitting the active ranks' step into sub-launches is
+        a wire-sequence risk not worth taking next to a blocked peer. The
+        eager split and replay's stage plan both resolve through here, so
+        warmup and steady state always pick the same schedule."""
+        base = self.config.overlap_pipeline
+        if base == "off":
+            return "off"
+        mode = base
+        if base == "auto":
+            mode = ("staged"
+                    if (self.backend.size() > 1 and (sharded or n_buckets > 1)
+                        and nbytes >= self.config.overlap_stage_bytes)
+                    else "interleave")
+        if (mode == "staged" and self.config.join_enabled
+                and self.backend.size() > 1):
+            return "interleave"
+        return mode
+
+    def _note_overlap_step(self, mode: str) -> None:
+        """Count a step serviced by a pipelined schedule. Inside a
+        step_begin/step_end bracket the latch keeps k grouped launches
+        from inflating the counter's 'steps' semantics (one bump per
+        bracketed step); an unbracketed call counts as its own degenerate
+        step. Replayed steps bump the counter in replay.py — interception
+        returns before this path runs, so the two never double-count."""
+        if self._in_step_bracket:
+            if self._overlap_step_noted:
+                return
+            self._overlap_step_noted = True
+        self._m_overlap_steps.inc(mode=mode)
+
+    def _note_prefetch(self, update_key: tuple) -> None:
+        """Record a launched ZeRO-1 all-gather prefetch leg. The leg is
+        held across the step boundary (nothing blocks on it at step_end —
+        consumers chain on its dataflow futures, which is also what keeps
+        its buffers alive; the registry row carries only the world version
+        for invalidation accounting) and dropped on world-version bumps,
+        join(), and explicit resets. The row is retired — without counting
+        an invalidation — when the next step's grads for the same
+        ``update_key`` arrive (sharded_step's head): those grads were
+        computed from the leg's gathered params, i.e. the leg was reused,
+        so ``hvd_tpu_overlap_prefetch_invalidations_total`` only ever
+        counts legs genuinely dropped before reuse."""
+        self._zero1_prefetch[update_key] = {
+            "world_version": self.world_version}
+        self._m_prefetch.inc()
+
+    def invalidate_prefetch(self, reason: str) -> None:
+        """Drop every held prefetch leg (the replay-invalidation contract
+        applied to the prefetch subsystem: invalidate, never poison — the
+        next sharded step simply re-gathers)."""
+        if not self._zero1_prefetch:
+            return
+        dropped = len(self._zero1_prefetch)
+        self._zero1_prefetch.clear()
+        self._m_prefetch_inval.inc(dropped)
+        self._emit_replay("prefetch-invalidate", reason)
+
+    def _prefetch_gc(self) -> None:
+        """Drop held legs whose world version is stale (an elastic bump
+        observed outside the replay step markers)."""
+        v = self.world_version
+        stale = [k for k, ent in self._zero1_prefetch.items()
+                 if ent["world_version"] != v]
+        for k in stale:
+            del self._zero1_prefetch[k]
+        if stale:
+            self._m_prefetch_inval.inc(len(stale))
+            self._emit_replay("prefetch-invalidate",
+                              f"world-version bump (-> {v})")
 
     def _emit_replay(self, event: str, detail: str):
         if self.on_replay is not None:
@@ -560,6 +672,14 @@ class Engine:
                      "single_launch", "step_replay", "shard_optimizer"):
             if pm.tunes(knob):
                 setattr(self.config, knob, pm.categorical_value(knob))
+        # overlap_pipeline is a string-mode knob: the categorical toggles
+        # between "off" and the env-resolved base mode (auto/interleave/
+        # staged), so the tuner explores serial-vs-pipelined without
+        # inventing modes the user did not ask for
+        if pm.tunes("overlap_pipeline"):
+            self.config.overlap_pipeline = (
+                self._overlap_base
+                if pm.categorical_value("overlap_pipeline") else "off")
 
     def _dispatch(self, names, fn, *args):
         """Dispatch with failure translation + a timeline ACTIVITY span per
@@ -1006,6 +1126,13 @@ class Engine:
             shapes = tuple(tuple(t.shape) for t in tensors)
             dtypes = tuple(str(t.dtype) for t in tensors)
             bkey = tuple(tuple(b) for b in buckets)
+            # overlap (ISSUE 6): trace the program's collectives
+            # back-to-back so no unpack interposes between two buckets'
+            # reduces — same launch count, strictly freer schedule
+            pipe = self._overlap_mode(sum(t.nbytes for t in tensors),
+                                      len(buckets)) != "off"
+            if pipe:
+                self._note_overlap_step("interleave")
             pack_fn = self._builder(
                 ("pack_group", shapes, dtypes, bkey),
                 lambda: C.build_pack_group(buckets))
@@ -1013,11 +1140,12 @@ class Engine:
             packed = _translate_failure(pack_fn, *tensors)
             fn = self._builder(
                 ("grouped_allreduce", op, prescale_factor,
-                 postscale_factor, shapes, dtypes, bkey, hier_local),
+                 postscale_factor, shapes, dtypes, bkey, hier_local, pipe),
                 lambda: C.build_grouped_allreduce(
                     mesh, self._axis(), op, shapes,
                     [t.dtype for t in tensors], buckets,
-                    prescale_factor, postscale_factor, hier_local))
+                    prescale_factor, postscale_factor, hier_local,
+                    pipeline=pipe))
             outs = self._dispatch(
                 names,
                 lambda: fn(*[self.backend.to_global(p, batched=True)
@@ -1141,25 +1269,100 @@ class Engine:
                                 lambda: C.build_pack_group(buckets))
         self._count_dispatch()
         packed = _translate_failure(pack_fn, *tensors)
-        fn = self._builder(
-            ("sharded_step", op, prescale_factor, postscale_factor,
+        # overlap (ISSUE 6): a stale world version invalidates held
+        # prefetch legs even when the caller runs outside step markers
+        self._refresh_world_version()
+        self._prefetch_gc()
+        # the grads arriving now were computed from the previous leg's
+        # gathered params — that leg was REUSED, so retire its registry row
+        # (after the gc above, which must still count bump-stranded rows):
+        # invalidation counters only ever see legs dropped before this point
+        self._zero1_prefetch.pop(update_key, None)
+        mode = self._overlap_mode(sum(t.nbytes for t in tensors),
+                                  len(buckets), sharded=True)
+        # the split leg is a property of the STAGED schedule — the one
+        # replay sustains with a zupd+zag stage plan. Splitting under
+        # interleave would launch warmup-only legs that vanish (and strand
+        # registry rows) the moment replay arms its monolithic program.
+        prefetch = self.config.zero1_prefetch and mode == "staged"
+        if not prefetch:
+            if mode != "off":
+                # mode label = the schedule actually dispatched: this
+                # branch is ONE fused pipelined launch however the config
+                # resolved, i.e. interleave scheduling (the staged split
+                # only exists in replay's stage plan / the prefetch branch)
+                self._note_overlap_step("interleave")
+            fn = self._builder(
+                ("sharded_step", op, prescale_factor, postscale_factor,
+                 shapes, dtypes, bkey, st_shapes, st_dtypes, update_key,
+                 mode != "off"),
+                lambda: C.build_sharded_step(
+                    mesh, self._axis(), op, shapes,
+                    [t.dtype for t in tensors],
+                    buckets, st_shapes, st_dtypes, update_fn,
+                    prescale_factor, postscale_factor,
+                    pipeline=(mode != "off")))
+            outs = self._dispatch(
+                names,
+                lambda: fn(*([self.backend.to_global(p, batched=True)
+                              for p in packed]
+                             + [self.backend.world_view(s)
+                                for s in state_leaves])))
+            group = LaunchGroup(outs[-1])
+            handles = []
+            for i, nm in enumerate(names):
+                h = Handle(nm, [outs[i]],
+                           lambda gs: self.backend.from_replicated(gs[0]),
+                           self, group=group, kind="sharded_step")
+                self._track(nm, h)
+                handles.append(h)
+            return handles
+        # -- split ZeRO-1 step with all-gather prefetch (the tentpole) --
+        # Launch 1: rs -> shard-local update, returning the STACKED updated
+        # parameter shards + new state. Launch 2 (the prefetch leg): the
+        # parameter all-gather, riding as its own launch under the step's
+        # tail — state consumers never wait on it, step N+1's forward
+        # chains onto its dataflow futures, and the engine holds the leg
+        # across the step boundary (dropped on world-version bumps).
+        upd_fn = self._builder(
+            ("sharded_update", op, prescale_factor, postscale_factor,
              shapes, dtypes, bkey, st_shapes, st_dtypes, update_key),
-            lambda: C.build_sharded_step(
+            lambda: C.build_sharded_update(
                 mesh, self._axis(), op, shapes, [t.dtype for t in tensors],
                 buckets, st_shapes, st_dtypes, update_fn,
-                prescale_factor, postscale_factor))
+                prescale_factor, postscale_factor, packed=True))
         outs = self._dispatch(
             names,
-            lambda: fn(*([self.backend.to_global(p, batched=True)
-                          for p in packed]
-                         + [self.backend.world_view(s)
-                            for s in state_leaves])))
-        group = LaunchGroup(outs[-1])
+            lambda: upd_fn(*([self.backend.to_global(p, batched=True)
+                              for p in packed]
+                             + [self.backend.world_view(s)
+                                for s in state_leaves])))
+        shard_garrs = outs[:len(buckets)]
+        state_garrs = outs[len(buckets):]
+        upd_group = LaunchGroup(outs[-1])
+        failpoint("overlap.prefetch")
+        ag_fn = self._builder(
+            ("zero1_prefetch_allgather", shapes, dtypes, bkey),
+            lambda: C.build_grouped_allgather(
+                mesh, self._axis(), shapes, [t.dtype for t in tensors],
+                buckets, pipeline=True))
+        ag_outs = self._dispatch(names[:len(tensors)],
+                                 lambda: ag_fn(*shard_garrs))
+        ag_group = LaunchGroup(ag_outs[-1])
+        self._note_prefetch(update_key)
+        self._m_overlap_stages.inc(2.0, kind="sharded_prefetch")
+        # two staged sub-launches, matching the stage-launch accounting
+        # above — this branch is only reachable with mode == "staged"
+        self._note_overlap_step("staged")
         handles = []
         for i, nm in enumerate(names):
-            h = Handle(nm, [outs[i]],
-                       lambda gs: self.backend.from_replicated(gs[0]), self,
-                       group=group, kind="sharded_step")
+            if i < len(tensors):
+                garr, group = ag_outs[i], ag_group
+            else:
+                garr, group = state_garrs[i - len(tensors)], upd_group
+            h = Handle(nm, [garr],
+                       lambda gs: self.backend.from_replicated(gs[0]),
+                       self, group=group, kind="sharded_step")
             self._track(nm, h)
             handles.append(h)
         return handles

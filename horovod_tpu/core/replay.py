@@ -162,6 +162,15 @@ class _Armed(NamedTuple):
     hier_local: int
     join_metas: Optional[list]    # np rows for the one-step advertisement
     join_kind: str = "grouped_allreduce"   # advertisement kind for the rows
+    # bucket-pipelined overlap (ISSUE 6): resolved mode + the per-bucket
+    # stage plan for "staged" mode (empty = monolithic launch)
+    mode: str = "off"
+    stages: tuple = ()
+    n_buckets: int = 1
+    has_sharded: bool = False
+    # zero1_prefetch as resolved when the stage plan was built — a live
+    # flip of the knob must rebuild the armed program
+    prefetch: bool = True
 
 
 class StepReplay:
@@ -276,7 +285,10 @@ class StepReplay:
 
     def invalidate_all(self, reason: str):
         """Drop every armed stream and recorded streak (join(), elastic
-        world-version bumps, explicit resets)."""
+        world-version bumps, explicit resets). Held ZeRO-1 prefetch legs
+        ride the same invalidation edge — a leg must never outlive the
+        world it was gathered for (invalidate, not poison)."""
+        self.engine.invalidate_prefetch(reason)
         had_armed = any(e.get("armed") for e in self._seen.values())
         self._seen.clear()
         if self._mode in ("replay", "drain"):
@@ -390,10 +402,22 @@ class StepReplay:
         cfg = self.engine.config
         hier = self._hier_local()
         if (armed.threshold != cfg.fusion_threshold_bytes
-                or armed.hier_local != hier):
+                or armed.hier_local != hier
+                or armed.mode != self._overlap_mode(armed.nbytes,
+                                                    armed.n_buckets,
+                                                    armed.has_sharded)
+                or armed.prefetch != bool(cfg.zero1_prefetch)):
             armed = self._build_armed(stream)
             ent["armed"] = armed
         return armed
+
+    def _overlap_mode(self, nbytes: int, n_buckets: int,
+                      has_sharded: bool) -> str:
+        """The engine's overlap mode for this stream. The Join-live
+        demotion (staged -> interleave next to a blocked peer) lives in
+        Engine._overlap_mode so the eager warmup path and the armed
+        program always resolve the same schedule."""
+        return self.engine._overlap_mode(nbytes, n_buckets, has_sharded)
 
     def _hier_local(self) -> int:
         eng = self.engine
@@ -455,9 +479,11 @@ class StepReplay:
             join_metas = rows
         hier_local = self._hier_local()
         built = []
+        seg_dtypes = []
         nbytes = 0
         for seg in segs:
             cls = seg["cls"]
+            seg_dtypes.append(tuple(seg["dtypes"]))
             if cls == "sharded":
                 # the bucket layout is the CALLER'S frozen layout (carried
                 # in the sig's extra) — never re-derived from the live
@@ -480,11 +506,66 @@ class StepReplay:
                           hier_local if cls == "reduce" else 0,
                           tuple(seg["shapes"]),
                           tuple(tuple(b) for b in buckets)))
+        n_buckets = sum(len(seg[6]) for seg in built)
+        has_sharded = any(seg[0] == "sharded" for seg in built)
+        mode = self._overlap_mode(nbytes, n_buckets, has_sharded)
+        prefetch = bool(cfg.zero1_prefetch)
+        stages = (self._stage_plan(built, seg_dtypes, prefetch)
+                  if mode == "staged" else ())
         return _Armed(stream, tuple(built),
                       ("replay_step", stream, cfg.fusion_threshold_bytes,
-                       hier_local),
+                       hier_local, mode),
                       nbytes, cfg.fusion_threshold_bytes, hier_local,
-                      join_metas, join_kind)
+                      join_metas, join_kind, mode, stages, n_buckets,
+                      has_sharded, prefetch)
+
+    @staticmethod
+    def _stage_plan(built: tuple, seg_dtypes: list,
+                    prefetch: bool = True) -> tuple:
+        """Split the armed segment list into per-bucket sub-launches (the
+        "staged" overlap mode): stage k's collective is already in flight
+        while the host dispatches stage k+1's pack — dispatch-level
+        pipelining the monolithic launch cannot express. A sharded segment
+        becomes TWO stages: the rs->shard-update launch, then the
+        parameter all-gather launch (the ZeRO-1 prefetch leg that rides
+        under the step's tail) — unless ``prefetch`` is off
+        (HOROVOD_TPU_ZERO1_PREFETCH=0), which keeps the documented fused
+        rs->update->ag single launch per sharded segment. Stage tuples:
+
+        - ``("seg", sub_segment, in_idx, out_idx)`` — one bucket of a
+          reduce/bcast segment as a single-bucket replay program;
+        - ``("zupd", segment, in_idx, state_out_idx)`` — rs + shard-local
+          update, emitting stacked shards + new state;
+        - ``("zag", grad_shapes, grad_dtypes, buckets, out_idx,
+          update_key)`` — the prefetch all-gather, consuming the previous
+          zupd stage's shard outputs."""
+        stages = []
+        base = 0
+        for seg, dtypes in zip(built, seg_dtypes):
+            cls, code, pre, post, local, shapes, buckets = seg
+            if cls == "sharded" and not prefetch:
+                # prefetch disabled: one fused rs->update->ag sub-launch
+                io = tuple(range(base, base + len(shapes)))
+                stages.append(("seg", seg, io, io))
+            elif cls == "sharded":
+                op_code, update_key, n_grads = code
+                in_idx = tuple(range(base, base + len(shapes)))
+                state_out_idx = tuple(range(base + n_grads,
+                                            base + len(shapes)))
+                stages.append(("zupd", seg, in_idx, state_out_idx))
+                stages.append(("zag", tuple(shapes[:n_grads]),
+                               tuple(dtypes[:n_grads]), buckets,
+                               tuple(range(base, base + n_grads)),
+                               update_key))
+            else:
+                for idxs in buckets:
+                    sub_shapes = tuple(shapes[i] for i in idxs)
+                    sub_seg = (cls, code, pre, post, local, sub_shapes,
+                               (tuple(range(len(idxs))),))
+                    io = tuple(base + i for i in idxs)
+                    stages.append(("seg", sub_seg, io, io))
+            base += len(shapes)
+        return tuple(stages)
 
     def _fallback(self, reason: str):
         self.fallbacks += 1
@@ -526,11 +607,6 @@ class StepReplay:
             # one fire-and-forget advertisement for the WHOLE step (the
             # per-op join rounds the recorded path paid, collapsed to one)
             eng._join_sync(armed.join_kind, armed.join_metas)
-        fn = eng._builder(armed.builder_key,
-                          lambda: engine_mod.C.build_replay_step(
-                              eng.backend.group_mesh, eng._axis(),
-                              armed.segments,
-                              sharded_updates=eng._sharded_updates))
         rep_name = f"replay.step.{self._step_token & 1023}"
         if eng.trace is not None:
             # the fused launch bypasses _register: stamp its correlation id
@@ -541,27 +617,45 @@ class StepReplay:
                                      eng.world_version)
         if eng.on_enqueue is not None:
             eng.on_enqueue(rep_name, "replay", armed.nbytes)
-        t0 = time.perf_counter()
-        outs = engine_mod._translate_failure(
-            lambda: fn(*[eng.backend.world_view(t) for t in flat]))
-        eng._count_dispatch()
-        if eng.trace is not None:
-            eng.trace.record_dispatch(rep_name, "XLA_REPLAY_DISPATCH",
-                                      time.perf_counter() - t0)
-        if eng.on_activity is not None:
-            eng.on_activity(rep_name, "XLA_REPLAY_DISPATCH",
-                            (time.perf_counter() - t0) * 1e6)
-        group = engine_mod.LaunchGroup(outs[-1])
+        if armed.mode == "staged" and armed.stages:
+            slot_garrs, slot_groups, group = self._launch_stages(
+                armed, flat, rep_name)
+            n_launches = len(armed.stages)
+        else:
+            fn = eng._builder(armed.builder_key,
+                              lambda: engine_mod.C.build_replay_step(
+                                  eng.backend.group_mesh, eng._axis(),
+                                  armed.segments,
+                                  sharded_updates=eng._sharded_updates,
+                                  pipeline=(armed.mode != "off")))
+            t0 = time.perf_counter()
+            outs = engine_mod._translate_failure(
+                lambda: fn(*[eng.backend.world_view(t) for t in flat]))
+            eng._count_dispatch()
+            if eng.trace is not None:
+                eng.trace.record_dispatch(rep_name, "XLA_REPLAY_DISPATCH",
+                                          time.perf_counter() - t0)
+            if eng.on_activity is not None:
+                eng.on_activity(rep_name, "XLA_REPLAY_DISPATCH",
+                                (time.perf_counter() - t0) * 1e6)
+            group = engine_mod.LaunchGroup(outs[-1])
+            slot_garrs = list(outs)
+            slot_groups = [group] * len(outs)
+            n_launches = 1
+        if armed.mode != "off":
+            eng._m_overlap_steps.inc(mode=armed.mode)
         k = 0
         for ci, sig in enumerate(stream):
             hs = self._handles[ci] if ci < len(self._handles) else None
             for j in range(len(sig.shapes)):
                 if hs is not None:
-                    hs[j]._bound = _Bound(outs[k], group, eng)
+                    hs[j]._bound = _Bound(slot_garrs[k], slot_groups[k],
+                                          eng)
                 k += 1
         # ONE tracked representative per replayed step: retires through the
         # cycle loop, feeds the stall inspector and timeline done events
-        rep = engine_mod.Handle(rep_name, [outs[-1]], lambda gs: None, eng,
+        rep = engine_mod.Handle(rep_name, [slot_garrs[-1]],
+                                lambda gs: None, eng,
                                 group=group, kind="replay")
         eng._track(rep_name, rep)
         self._launched = True
@@ -569,4 +663,91 @@ class StepReplay:
             self.replayed_steps += 1
             self._m_replayed.inc()
             eng._emit_replay(
-                "replay", f"{len(flat)} tensors in 1 launch ({rep_name})")
+                "replay", f"{len(flat)} tensors in {n_launches} "
+                f"launch(es) ({rep_name}, overlap={armed.mode})")
+
+    def _launch_stages(self, armed: _Armed, flat: list, rep_name: str):
+        """Dispatch one armed step as its per-bucket stage pipeline
+        ("staged" overlap mode): each stage is its own launch, so stage
+        k's collective is on the wire while the host dispatches stage
+        k+1's pack — and the final "zag" stage is the ZeRO-1 parameter
+        all-gather prefetch leg the engine holds across the step boundary.
+        Returns (slot_garrs, slot_groups, last_group)."""
+        from . import engine as engine_mod
+        from ..common.reduce_ops import ReduceOp
+        from ..faults import failpoint
+        eng = self.engine
+        mesh = eng.backend.group_mesh
+        axis = eng._axis()
+        slot_garrs: list = [None] * len(flat)
+        slot_groups: list = [None] * len(flat)
+        held_shards = None
+        group = None
+        for st in armed.stages:
+            t0 = time.perf_counter()
+            kind = st[0]
+            if kind == "seg":
+                _, sub_seg, in_idx, out_idx = st
+                fn = eng._builder(
+                    ("replay_stage", sub_seg),
+                    lambda: engine_mod.C.build_replay_step(
+                        mesh, axis, (sub_seg,),
+                        sharded_updates=eng._sharded_updates,
+                        pipeline=True))
+                args = [eng.backend.world_view(flat[i]) for i in in_idx]
+                outs = engine_mod._translate_failure(lambda: fn(*args))
+                group = engine_mod.LaunchGroup(outs[-1])
+                for pos, i in enumerate(out_idx):
+                    slot_garrs[i] = outs[pos]
+                    slot_groups[i] = group
+            elif kind == "zupd":
+                _, seg, in_idx, state_out_idx = st
+                _cls, code, pre, post, _local, shapes, buckets = seg
+                op_code, update_key, n_grads = code
+                # registry read stays inside the builder factory so it
+                # happens at trace time only (the monolithic path's
+                # documented LRU contract: eviction after arming is
+                # harmless) — a steady-state dispatch never touches it
+                fn = eng._builder(
+                    ("replay_zupd", seg),
+                    lambda: engine_mod.C.build_sharded_update(
+                        mesh, axis, ReduceOp(op_code),
+                        tuple(shapes[:n_grads]), None, buckets,
+                        tuple(shapes[n_grads:]), None,
+                        eng._sharded_updates[update_key], pre, post,
+                        packed=False))
+                args = [eng.backend.world_view(flat[i]) for i in in_idx]
+                outs = engine_mod._translate_failure(lambda: fn(*args))
+                group = engine_mod.LaunchGroup(outs[-1])
+                held_shards = outs[:len(buckets)]
+                for pos, i in enumerate(state_out_idx):
+                    slot_garrs[i] = outs[len(buckets) + pos]
+                    slot_groups[i] = group
+            else:  # "zag": the prefetch leg, consuming the zupd shards
+                _, gshapes, gdtypes, buckets, out_idx, update_key = st
+                failpoint("overlap.prefetch")
+                # same cache key as the eager prefetch leg (engine.py's
+                # sharded_step): the programs are byte-identical, so the
+                # first staged step reuses the warmup path's compile
+                fn = eng._builder(
+                    ("zero1_prefetch_allgather", gshapes, gdtypes,
+                     buckets),
+                    lambda: engine_mod.C.build_grouped_allgather(
+                        mesh, axis, gshapes, gdtypes, buckets,
+                        pipeline=True))
+                shards = held_shards
+                outs = engine_mod._translate_failure(lambda: fn(*shards))
+                group = engine_mod.LaunchGroup(outs[-1])
+                eng._note_prefetch(update_key)
+                for pos, i in enumerate(out_idx):
+                    slot_garrs[i] = outs[pos]
+                    slot_groups[i] = group
+            eng._count_dispatch()
+            eng._m_overlap_stages.inc(kind="replay_" + kind)
+            if eng.trace is not None:
+                eng.trace.record_dispatch(rep_name, "XLA_REPLAY_DISPATCH",
+                                          time.perf_counter() - t0)
+            if eng.on_activity is not None:
+                eng.on_activity(rep_name, "XLA_REPLAY_DISPATCH",
+                                (time.perf_counter() - t0) * 1e6)
+        return slot_garrs, slot_groups, group

@@ -160,6 +160,13 @@ class GlobalState:
             # with sharded=None AFTER the flip — live optimizer state
             # shapes are frozen at their init (optimizer._is_sharded).
             categorical += ["shard_optimizer"]
+            # bucket-pipelined comm/compute overlap (ISSUE 6): serial vs
+            # pipelined collective schedule inside the fused step. The
+            # categorical toggles "off" vs the env-resolved base mode
+            # (engine._pm_step maps the boolean onto the string knob);
+            # whether overlap pays is a per-runtime fact — dispatch
+            # overhead vs wire time — exactly the step_replay trade.
+            categorical += ["overlap_pipeline"]
             self.parameter_manager = ParameterManager(
                 warmup_samples=cfg.autotune_warmup_samples,
                 steps_per_sample=cfg.autotune_steps_per_sample,
@@ -181,6 +188,7 @@ class GlobalState:
                     "single_launch": cfg.single_launch,
                     "step_replay": cfg.step_replay,
                     "shard_optimizer": cfg.shard_optimizer,
+                    "overlap_pipeline": cfg.overlap_pipeline != "off",
                 })
             self.engine.parameter_manager = self.parameter_manager
 

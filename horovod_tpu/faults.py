@@ -90,6 +90,11 @@ FAULT_SPECS: Dict[str, str] = {
                        "stopped contributing mid-step",
     "engine.complete": "At the top of Handle.synchronize, before the "
                        "completion wait — the user-visible completion edge",
+    "overlap.prefetch": "Before the ZeRO-1 parameter all-gather prefetch "
+                        "leg is launched under the step tail (ISSUE 6): "
+                        "raise() models a prefetch launch failure — it "
+                        "must surface as HorovodInternalError for the "
+                        "elastic loop, never poison held state",
     # runner/http_client.py
     "kv.put": "Inside each PUT attempt of put_data_into_kvstore (before "
               "the HTTP request) — transient KV-fabric write outages",

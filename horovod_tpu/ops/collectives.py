@@ -461,7 +461,8 @@ def build_grouped_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
                             shapes, dtypes, buckets,
                             prescale_factor: float = 1.0,
                             postscale_factor: float = 1.0,
-                            local_size: int = 0):
+                            local_size: int = 0,
+                            pipeline: bool = False):
     """ONE launch for the whole grouped reduce+unpack: the per-bucket
     packed buffers (from :func:`build_pack_group`, stacked (n, total_b))
     go in, every reduced tensor of the group comes out — one collective
@@ -477,6 +478,14 @@ def build_grouped_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
       shapes/dtypes: per-tensor, in group order.
       buckets: list of index lists partitioning range(len(shapes)),
         same-dtype within a bucket (bucket_by_size output).
+      pipeline: issue every bucket's collective back-to-back BEFORE any
+        unpack is traced (ISSUE 6 overlap): the serial form interleaves
+        bucket i's unpack between bucket i's reduce and bucket i+1's
+        reduce, so an in-order scheduler must drain reduce(i) before it
+        can issue anything of bucket i+1; the pipelined trace order
+        (scale..., reduce..., unpack...) leaves the collectives mutually
+        independent and adjacent, which is what XLA's async-collective
+        conversion / latency-hiding scheduler overlaps.
     """
     _check_bucket_dtypes(dtypes, buckets)
     n = int(mesh.devices.size)
@@ -485,6 +494,19 @@ def build_grouped_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
 
     def body(*packed):  # per-bucket blocks (1, total_b)
         outs = [None] * len(shapes)
+        if pipeline:
+            flats = []
+            for b in range(len(buckets)):
+                flat = packed[b][0]
+                if prescale_factor != 1.0:
+                    flat = flat * prescale_factor
+                flats.append(flat)
+            reds = [_reduce_flat(f) for f in flats]
+            if postscale_factor != 1.0:
+                reds = [r * postscale_factor for r in reds]
+            for b, idxs in enumerate(buckets):
+                _unpack_flat(reds[b], shapes, sizes, idxs, outs)
+            return tuple(outs)
         for b, idxs in enumerate(buckets):
             flat = packed[b][0]
             if prescale_factor != 1.0:
@@ -592,7 +614,8 @@ def _unpack_flat(flat, shapes, sizes, idxs, outs):
 def build_grouped_reducescatter(mesh: Mesh, axis: str, op: ReduceOp,
                                 shapes, dtypes, buckets,
                                 prescale_factor: float = 1.0,
-                                postscale_factor: float = 1.0):
+                                postscale_factor: float = 1.0,
+                                pipeline: bool = False):
     """ONE launch for a whole grouped reduce-scatter: the per-bucket packed
     buffers (from :func:`build_pack_group`, stacked (n, total_b)) go in, one
     stacked (n, shard_b) array per bucket comes out — rank r's addressable
@@ -602,12 +625,25 @@ def build_grouped_reducescatter(mesh: Mesh, axis: str, op: ReduceOp,
     caller keeps only 1/n of the reduced elements, which is what lets the
     optimizer update and its state shrink by the world size (ZeRO-1).
     Bucket totals need not divide n — shards are over the zero-padded
-    buffer (:func:`shard_spec`)."""
+    buffer (:func:`shard_spec`). ``pipeline=True`` traces every bucket's
+    scale before any reduce-scatter so the collectives issue back-to-back
+    (overlap-ready, ISSUE 6)."""
     _check_bucket_dtypes(dtypes, buckets)
     n = int(mesh.devices.size)
 
     def body(*packed):  # per-bucket blocks (1, total_b)
         outs = []
+        if pipeline:
+            flats = []
+            for b in range(len(buckets)):
+                flat = packed[b][0]
+                if prescale_factor != 1.0:
+                    flat = flat * prescale_factor
+                flats.append(flat)
+            shards = [_rs_flat(f, axis, n, op) for f in flats]
+            if postscale_factor != 1.0:
+                shards = [s * postscale_factor for s in shards]
+            return tuple(s[None] for s in shards)
         for b, idxs in enumerate(buckets):
             flat = packed[b][0]
             if prescale_factor != 1.0:
@@ -624,18 +660,28 @@ def build_grouped_reducescatter(mesh: Mesh, axis: str, op: ReduceOp,
     return jax.jit(fn)
 
 
-def build_grouped_allgather(mesh: Mesh, axis: str, shapes, dtypes, buckets):
+def build_grouped_allgather(mesh: Mesh, axis: str, shapes, dtypes, buckets,
+                            pipeline: bool = False):
     """Inverse of :func:`build_grouped_reducescatter` and the return leg of
     the sharded optimizer step: per-bucket stacked shards (n, shard_b) in,
     every tensor of the group out — replicated, unpacked to its natural
     shape, padding trimmed. One all-gather per bucket in a single
-    program."""
+    program. ``pipeline=True`` issues every bucket's all-gather before any
+    unpack is traced (bucket i's unpack no longer interposes between
+    gather i and gather i+1 — overlap-ready, ISSUE 6); this is also the
+    program the ZeRO-1 prefetch leg launches under the step's tail."""
     _check_bucket_dtypes(dtypes, buckets)
     sizes = [math.prod(s) for s in shapes]
     totals = [sum(sizes[i] for i in idxs) for idxs in buckets]
 
     def body(*shards):  # per-bucket blocks (1, shard_b)
         outs = [None] * len(shapes)
+        if pipeline:
+            fulls = [_ag_flat(shards[b][0], axis, totals[b])
+                     for b in range(len(buckets))]
+            for b, idxs in enumerate(buckets):
+                _unpack_flat(fulls[b], shapes, sizes, idxs, outs)
+            return tuple(outs)
         for b, idxs in enumerate(buckets):
             full = _ag_flat(shards[b][0], axis, totals[b])
             _unpack_flat(full, shapes, sizes, idxs, outs)
@@ -649,11 +695,85 @@ def build_grouped_allgather(mesh: Mesh, axis: str, shapes, dtypes, buckets):
     return jax.jit(fn)
 
 
+def _check_state_leaves(state, new_state):
+    """Trace-time shape/dtype stability contract shared by the fused and
+    split ZeRO-1 step builders."""
+    if len(new_state) != len(state):
+        raise ValueError(
+            f"sharded update changed the state leaf count "
+            f"({len(state)} -> {len(new_state)})")
+    for old, new in zip(state, new_state):
+        if old.shape != new.shape or old.dtype != new.dtype:
+            raise ValueError(
+                f"sharded update changed a state leaf's shape/dtype "
+                f"({old.shape}/{old.dtype} -> {new.shape}/{new.dtype}); "
+                f"shard-local state must be shape-stable")
+
+
+def build_sharded_update(mesh: Mesh, axis: str, op: ReduceOp,
+                         shapes, dtypes, buckets,
+                         state_shapes, state_dtypes, update,
+                         prescale_factor: float = 1.0,
+                         postscale_factor: float = 1.0,
+                         packed: bool = True):
+    """The FIRST pipeline stage of a split ZeRO-1 step (ISSUE 6 prefetch):
+    reduce-scatter every gradient bucket (issued back-to-back, no unpack
+    interposing) and run ``update`` on this rank's shards — but do NOT
+    all-gather. Outputs are the per-bucket *stacked* updated-parameter
+    shards (n, shard_b), exactly what :func:`build_grouped_allgather`
+    consumes as its own launch, followed by the new state leaves. Splitting
+    the all-gather out lets the engine hold it as a prefetch leg across the
+    step boundary: state consumers never wait on the gather, and the
+    gather's wire time rides under the step's tail instead of on the
+    update's critical path.
+
+    ``packed=True``: inputs are per-bucket packed buffers (n, total_b)
+    from :func:`build_pack_group` (engine path). ``packed=False``: inputs
+    are the raw gradient tensors in natural shapes presented as world
+    views (the staged replay path — same input convention as
+    :func:`build_replay_step`)."""
+    if dtypes is not None:
+        _check_bucket_dtypes(dtypes, buckets)
+    n = int(mesh.devices.size)
+
+    def body(*args):
+        n_in = len(buckets) if packed else len(shapes)
+        state = list(args[n_in:])
+        flats = []
+        for b, idxs in enumerate(buckets):
+            if packed:
+                flat = args[b][0]
+            else:
+                flat = jnp.concatenate([jnp.ravel(args[i]) for i in idxs])
+            if prescale_factor != 1.0:
+                flat = flat * prescale_factor
+            flats.append(flat)
+        # collectives issued back-to-back: mutually independent, the
+        # overlap-ready form
+        shards = [_rs_flat(f, axis, n, op) for f in flats]
+        if postscale_factor != 1.0:
+            shards = [s * postscale_factor for s in shards]
+        new_shards, new_state = update(shards, state)
+        _check_state_leaves(state, new_state)
+        return tuple(s[None] for s in new_shards) + tuple(new_state)
+
+    n_in = len(buckets) if packed else len(shapes)
+    in_specs = (tuple(P(axis) for _ in buckets) if packed
+                else tuple(P() for _ in shapes))
+    fn = _shmap(body, mesh, axis,
+                in_specs=in_specs + tuple(P() for _ in state_shapes),
+                out_specs=tuple(P(axis) for _ in buckets)
+                + tuple(P() for _ in state_shapes),
+                check_vma=False)
+    return jax.jit(fn)
+
+
 def build_sharded_step(mesh: Mesh, axis: str, op: ReduceOp,
                        shapes, dtypes, buckets,
                        state_shapes, state_dtypes, update,
                        prescale_factor: float = 1.0,
-                       postscale_factor: float = 1.0):
+                       postscale_factor: float = 1.0,
+                       pipeline: bool = False):
     """ONE launch for a whole ZeRO-1 optimizer step: per-bucket packed
     gradient buffers (stacked (n, total_b)) plus this rank's optimizer-state
     leaves (world-view lifted, genuinely different per rank) go in; the
@@ -668,6 +788,10 @@ def build_sharded_step(mesh: Mesh, axis: str, op: ReduceOp,
     state leaves' shapes/dtypes (asserted at trace time). The wire sequence
     is exactly one reduce-scatter and one all-gather per bucket — the same
     bytes as the fused allreduce, split around the shard-local update.
+    ``pipeline=True`` keeps the same wire sequence but traces each phase's
+    collectives back-to-back (all reduce-scatters, update, all
+    all-gathers, then unpacks) so no unpack interposes between two
+    collectives (ISSUE 6 overlap-ready ordering).
     """
     _check_bucket_dtypes(dtypes, buckets)
     n = int(mesh.devices.size)
@@ -677,30 +801,38 @@ def build_sharded_step(mesh: Mesh, axis: str, op: ReduceOp,
     def body(*args):
         packed = args[:len(buckets)]
         state = list(args[len(buckets):])
-        shards = []
-        for b in range(len(buckets)):
-            flat = packed[b][0]
-            if prescale_factor != 1.0:
-                flat = flat * prescale_factor
-            shard = _rs_flat(flat, axis, n, op)
+        if pipeline:
+            flats = []
+            for b in range(len(buckets)):
+                flat = packed[b][0]
+                if prescale_factor != 1.0:
+                    flat = flat * prescale_factor
+                flats.append(flat)
+            shards = [_rs_flat(f, axis, n, op) for f in flats]
             if postscale_factor != 1.0:
-                shard = shard * postscale_factor
-            shards.append(shard)
+                shards = [s * postscale_factor for s in shards]
+        else:
+            shards = []
+            for b in range(len(buckets)):
+                flat = packed[b][0]
+                if prescale_factor != 1.0:
+                    flat = flat * prescale_factor
+                shard = _rs_flat(flat, axis, n, op)
+                if postscale_factor != 1.0:
+                    shard = shard * postscale_factor
+                shards.append(shard)
         new_shards, new_state = update(shards, state)
-        if len(new_state) != len(state):
-            raise ValueError(
-                f"sharded update changed the state leaf count "
-                f"({len(state)} -> {len(new_state)})")
-        for old, new in zip(state, new_state):
-            if old.shape != new.shape or old.dtype != new.dtype:
-                raise ValueError(
-                    f"sharded update changed a state leaf's shape/dtype "
-                    f"({old.shape}/{old.dtype} -> {new.shape}/{new.dtype}); "
-                    f"shard-local state must be shape-stable")
+        _check_state_leaves(state, new_state)
         outs = [None] * len(shapes)
-        for b, idxs in enumerate(buckets):
-            full = _ag_flat(new_shards[b], axis, totals[b])
-            _unpack_flat(full, shapes, sizes, idxs, outs)
+        if pipeline:
+            fulls = [_ag_flat(new_shards[b], axis, totals[b])
+                     for b in range(len(buckets))]
+            for b, idxs in enumerate(buckets):
+                _unpack_flat(fulls[b], shapes, sizes, idxs, outs)
+        else:
+            for b, idxs in enumerate(buckets):
+                full = _ag_flat(new_shards[b], axis, totals[b])
+                _unpack_flat(full, shapes, sizes, idxs, outs)
         return tuple(outs) + tuple(new_state)
 
     # packed grads arrive stacked; state leaves are world-view claims (each
@@ -717,7 +849,7 @@ def build_sharded_step(mesh: Mesh, axis: str, op: ReduceOp,
 
 
 def build_replay_step(mesh: Mesh, axis: str, segments,
-                      sharded_updates=None):
+                      sharded_updates=None, pipeline: bool = False):
     """ONE launch for a whole captured eager step (core/replay.py): every
     recorded collective call's pack, reduction/broadcast, and unpack fused
     into a single jitted program — the XLA answer to CUDA-graph capture of
@@ -745,9 +877,87 @@ def build_replay_step(mesh: Mesh, axis: str, segments,
       sharded_updates: mapping update_key -> ``update(shards, state)``
         closure (engine._sharded_updates); required when any segment is
         ``"sharded"``.
+      pipeline: the ISSUE 6 overlap restructure. The serial trace order is
+        pack(0), reduce(0), unpack(0), pack(1), reduce(1), ... — bucket
+        0's unpack *consumes* reduce(0) and sits between it and bucket
+        1's collective, so an in-order scheduler serializes the whole
+        chain behind each wire leg. ``pipeline=True`` traces the step as
+        explicit software-pipeline phases instead: every bucket's pack
+        first, then every collective back-to-back (mutually independent —
+        nothing traced between two collectives consumes an earlier
+        collective's result), then shard-local updates + return
+        all-gathers, then every unpack. Same math, same wire bytes; the
+        collectives become async-overlappable (XLA's latency-hiding
+        scheduler / async collective conversion hides reduce(i) behind
+        pack(i+1) and the unpack epilogue).
     """
     n = int(mesh.devices.size)
     n_tensors = sum(len(seg[5]) for seg in segments)
+
+    def body_pipelined(*ts):
+        outs = [None] * n_tensors
+        bases = []
+        base = 0
+        for seg in segments:
+            bases.append(base)
+            base += len(seg[5])
+        # -- phase 1: every bucket's pack (pre-scaled), no collective yet --
+        packs = {}   # (seg_idx, bucket_idx) -> flat
+        for si, (cls, code, pre, post, local_size, shapes,
+                 buckets) in enumerate(segments):
+            for bi, idxs in enumerate(buckets):
+                flat = jnp.concatenate(
+                    [jnp.ravel(ts[bases[si] + i]) for i in idxs])
+                if cls != "bcast" and pre != 1.0:
+                    flat = flat * pre
+                packs[(si, bi)] = flat
+        # -- phase 2: every collective, issued back-to-back --
+        reds = {}    # (seg_idx, bucket_idx) -> reduced flat / shard
+        for si, (cls, code, pre, post, local_size, shapes,
+                 buckets) in enumerate(segments):
+            if cls == "reduce":
+                reduce_flat = _make_reduce_flat(axis, ReduceOp(code), n,
+                                                local_size)
+            for bi in range(len(buckets)):
+                flat = packs[(si, bi)]
+                if cls == "sharded":
+                    reds[(si, bi)] = _rs_flat(flat, axis, n,
+                                              ReduceOp(code[0]))
+                elif cls == "reduce":
+                    reds[(si, bi)] = reduce_flat(flat)
+                else:
+                    reds[(si, bi)] = broadcast_p(flat, axis, code)
+        # -- phase 3: shard-local updates + return all-gathers --
+        for si, (cls, code, pre, post, local_size, shapes,
+                 buckets) in enumerate(segments):
+            sizes = [math.prod(s) for s in shapes]
+            if cls == "sharded":
+                op_code, update_key, n_grads = code
+                shards = [reds[(si, bi)] for bi in range(len(buckets))]
+                if post != 1.0:
+                    shards = [s * post for s in shards]
+                state = [ts[bases[si] + j]
+                         for j in range(n_grads, len(shapes))]
+                new_shards, new_state = sharded_updates[update_key](
+                    shards, state)
+                for bi, idxs in enumerate(buckets):
+                    total = sum(sizes[i] for i in idxs)
+                    reds[(si, bi)] = _ag_flat(new_shards[bi], axis, total)
+                for j, leaf in enumerate(new_state):
+                    outs[bases[si] + n_grads + j] = leaf
+            elif cls == "reduce" and post != 1.0:
+                for bi in range(len(buckets)):
+                    reds[(si, bi)] = reds[(si, bi)] * post
+        # -- phase 4: every unpack (the epilogue nothing waits behind) --
+        for si, (cls, code, pre, post, local_size, shapes,
+                 buckets) in enumerate(segments):
+            sizes = [math.prod(s) for s in shapes]
+            for bi, idxs in enumerate(buckets):
+                seg_outs = [None] * len(shapes)
+                _unpack_flat(reds[(si, bi)], shapes, sizes, idxs, seg_outs)
+                for i in idxs:
+                    outs[bases[si] + i] = seg_outs[i]
+        return tuple(outs)
 
     def body(*ts):  # each rank's own local tensors, natural shapes
         outs = [None] * n_tensors
@@ -809,7 +1019,8 @@ def build_replay_step(mesh: Mesh, axis: str, segments,
     # inputs are claimed-replicated world views (varying in truth) and the
     # outputs are replicated by construction — the VMA checker can infer
     # neither, same as the ladder builders above
-    fn = _shmap(body, mesh, axis, in_specs=tuple(P() for _ in range(n_tensors)),
+    fn = _shmap(body_pipelined if pipeline else body, mesh, axis,
+                in_specs=tuple(P() for _ in range(n_tensors)),
                 out_specs=tuple(P() for _ in range(n_tensors)),
                 check_vma=False)
     return jax.jit(fn)

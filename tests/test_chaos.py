@@ -18,6 +18,7 @@ Covers the acceptance criteria:
 
 import json
 import logging
+import os
 import threading
 import time
 import urllib.error
@@ -266,6 +267,65 @@ def test_poisoned_engine_raises_instead_of_hanging():
         with pytest.raises(HorovodInternalError):
             hvd.barrier()
     finally:
+        hvd.shutdown()
+
+
+def test_sharded_prefetch_survives_elastic_restore():
+    """ISSUE 6 acceptance: the ZeRO-1 all-gather prefetch leg rides the
+    chaos suite's elastic restore. A one-shot injected prefetch-launch
+    failure surfaces as HorovodInternalError, the elastic run-loop
+    restores the last commit and re-enters training, and the prefetch
+    subsystem is still live afterwards (legs keep launching) — the
+    failure invalidated nothing it shouldn't and poisoned nothing."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from horovod_tpu.optimizer import DistributedEagerOptimizer
+    hvd.shutdown()
+    # legs ride the staged schedule — force it via env, not just the live
+    # config: the elastic restore rebuilds the engine (fresh Config from
+    # env), and the rebuilt engine must keep launching legs
+    os.environ["HOROVOD_TPU_OVERLAP_PIPELINE"] = "staged"
+    hvd.init()
+    eng = hvd.global_state().engine
+    reg = registry()
+    rec_before = reg.counter("hvd_tpu_elastic_recoveries_total").value(
+        kind="internal")
+    legs_before = reg.counter("hvd_tpu_overlap_prefetch_total").total()
+    try:
+        eng.config.zero1_prefetch = True
+        faults.arm("overlap.prefetch=1*raise(HorovodInternalError)")
+        opt = DistributedEagerOptimizer(optax.sgd(0.05), sharded=True)
+        box = {"params": {"w": jnp.ones((4, 4))}}
+        box["opt"] = opt.init(box["params"])
+        grad_fn = jax.jit(jax.grad(lambda p: jnp.sum(p["w"] ** 2)))
+        state = _CountingState(batch=0)
+        target = 4
+
+        @hvd.elastic.run
+        def train(state):
+            while state.batch < target:
+                g = grad_fn(box["params"])
+                box["params"], box["opt"] = opt.update_and_apply(
+                    g, box["opt"], box["params"])
+                state.batch += 1
+                state.commit()
+            return state.batch
+
+        assert train(state) == target
+        jax.block_until_ready(box["params"]["w"])
+        assert state.restores == 1, \
+            "run-loop never restored committed state"
+        assert faults.hits("overlap.prefetch") == 1
+        assert reg.counter("hvd_tpu_elastic_recoveries_total").value(
+            kind="internal") == rec_before + 1
+        # the prefetch subsystem kept launching legs after the restore
+        assert reg.counter("hvd_tpu_overlap_prefetch_total").total() \
+            > legs_before
+        assert bool(np.isfinite(np.asarray(box["params"]["w"])).all())
+    finally:
+        faults.disarm()
+        os.environ.pop("HOROVOD_TPU_OVERLAP_PIPELINE", None)
         hvd.shutdown()
 
 

@@ -1,0 +1,374 @@
+"""Bucket-pipelined comm/compute overlap + ZeRO-1 all-gather prefetch
+(ISSUE 6).
+
+Runs on the size-1 eager world (one process): the collective math is
+identity there, so every assertion checks the overlap plumbing — mode
+resolution, the split rs->update / prefetched-all-gather launch pair, the
+staged replay pipeline, dispatch accounting, world-version invalidation —
+and trajectory parity against the serial (overlap off) path, which must be
+BITWISE (same math, different schedule). Multi-participant wire behavior
+of the same builders is covered by tests/test_compiled_structure.py (IR
+structure) and tests/test_multiprocess.py (np=2 parity across an elastic
+world-version bump).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+from horovod_tpu import faults
+from horovod_tpu import metrics as hvd_metrics
+from horovod_tpu.common.exceptions import HorovodInternalError
+
+
+def _ctr(name):
+    return hvd_metrics.counter_total(hvd_metrics.snapshot(), name)
+
+
+@pytest.fixture()
+def engine():
+    hvd.init()
+    eng = hvd._engine()
+    prev = (eng.config.step_replay_warmup, eng.config.step_replay,
+            eng.config.overlap_pipeline, eng.config.zero1_prefetch,
+            eng.config.fusion_threshold_bytes)
+    eng.config.step_replay_warmup = 2
+    eng.config.step_replay = True
+    eng.replay.invalidate_all("test isolation")
+    yield eng
+    eng.replay.invalidate_all("test isolation")
+    (eng.config.step_replay_warmup, eng.config.step_replay,
+     eng.config.overlap_pipeline, eng.config.zero1_prefetch,
+     eng.config.fusion_threshold_bytes) = prev
+    os.environ.pop("HOROVOD_TPU_WORLD_VERSION", None)
+
+
+def _sharded_run(engine, mode, steps=6, lr=0.1, prefetch=True):
+    """Run ``steps`` ZeRO-1 sharded optimizer steps under ``mode`` from a
+    fixed start; returns the final params."""
+    import optax
+    from horovod_tpu.optimizer import DistributedEagerOptimizer
+    engine.config.overlap_pipeline = mode
+    engine.config.zero1_prefetch = prefetch
+    engine.replay.invalidate_all(f"mode -> {mode}")
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    opt = DistributedEagerOptimizer(optax.sgd(lr, momentum=0.9),
+                                    sharded=True)
+    state = opt.init(params)
+
+    def loss(p, x):
+        return jnp.sum((x @ p["w"] + p["b"]) ** 2)
+
+    grad_fn = jax.jit(jax.grad(loss))
+    x = jnp.ones((2, 4))
+    for _ in range(steps):
+        params, state = opt.update_and_apply(grad_fn(params, x), state,
+                                             params)
+    jax.block_until_ready(params["w"])
+    return params
+
+
+def test_overlap_mode_resolution(engine, monkeypatch):
+    """_overlap_mode: explicit modes pass through; "auto" picks interleave
+    on a size-1 world (staged sub-launches cannot overlap anything without
+    peers) and respects the stage-bytes threshold; Join-live worlds demote
+    staged to interleave on EVERY resolution path (forced or auto), so the
+    eager warmup split and replay's armed program always agree."""
+    cfg = engine.config
+    cfg.overlap_pipeline = "off"
+    assert engine._overlap_mode(1 << 30, 8, True) == "off"
+    cfg.overlap_pipeline = "staged"
+    assert engine._overlap_mode(0, 1) == "staged"
+    cfg.overlap_pipeline = "interleave"
+    assert engine._overlap_mode(1 << 30, 8) == "interleave"
+    cfg.overlap_pipeline = "auto"
+    # size-1 world: staged gains nothing, auto stays single-launch
+    assert engine._overlap_mode(1 << 30, 8, True) == "interleave"
+    # Join-live world with peers: staged demotes, forced or auto
+    monkeypatch.setattr(engine.backend, "size", lambda: 2)
+    prev_join = cfg.join_enabled
+    try:
+        cfg.join_enabled = True
+        cfg.overlap_pipeline = "staged"
+        assert engine._overlap_mode(1 << 30, 8, True) == "interleave"
+        cfg.overlap_pipeline = "auto"
+        assert engine._overlap_mode(1 << 30, 8, True) == "interleave"
+        cfg.join_enabled = False
+        assert engine._overlap_mode(1 << 30, 8, True) == "staged"
+        cfg.overlap_pipeline = "staged"
+        assert engine._overlap_mode(0, 1) == "staged"
+    finally:
+        cfg.join_enabled = prev_join
+
+
+def test_grouped_allreduce_pipelined_parity(engine):
+    """The pipelined grouped program must be value-identical to the serial
+    one (same math, different trace order) — bitwise, since the schedule
+    change reorders no arithmetic."""
+    from horovod_tpu.common.reduce_ops import ReduceOp
+    rng = np.random.RandomState(0)
+    tensors = [jnp.asarray(rng.randn(5, 3).astype(np.float32)),
+               jnp.asarray(rng.randn(17).astype(np.float32)),
+               jnp.asarray(rng.randn(2, 2).astype(np.float32))]
+    outs = {}
+    for mode in ("off", "interleave"):
+        engine.config.overlap_pipeline = mode
+        hs = engine.grouped_allreduce(list(tensors), name=f"par.{mode}",
+                                      op=ReduceOp.SUM)
+        outs[mode] = [np.asarray(h.synchronize()) for h in hs]
+    for a, b in zip(outs["off"], outs["interleave"]):
+        assert np.array_equal(a, b)
+
+
+def test_sharded_prefetch_trajectory_bitwise_equal(engine):
+    """The tentpole parity bar: the split rs->update + prefetched
+    all-gather trajectory is BITWISE equal to the serial fused step (the
+    schedule moves launches, never arithmetic). The split leg rides the
+    STAGED schedule only — under "auto" on this size-1 world the mode
+    resolves to "interleave" and the all-gather stays inside the fused
+    program (no warmup-only legs that would vanish once replay arms)."""
+    p_off = _sharded_run(engine, "off", prefetch=False)
+    legs0 = _ctr("hvd_tpu_overlap_prefetch_total")
+    p_auto = _sharded_run(engine, "auto")
+    for a, b in zip(jax.tree_util.tree_leaves(p_off),
+                    jax.tree_util.tree_leaves(p_auto)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert _ctr("hvd_tpu_overlap_prefetch_total") == legs0, \
+        "auto resolved interleave: the fused program must not split legs"
+    assert not engine._zero1_prefetch
+    p_staged = _sharded_run(engine, "staged")
+    for a, b in zip(jax.tree_util.tree_leaves(p_off),
+                    jax.tree_util.tree_leaves(p_staged)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert _ctr("hvd_tpu_overlap_prefetch_total") > legs0, \
+        "no prefetch leg was launched on the staged path"
+
+
+def test_staged_replay_sharded_two_launch_steady_state(engine):
+    """Forced "staged" mode: a steady-state replayed sharded step is
+    exactly TWO engine dispatches — the rs->shard-update launch and the
+    held all-gather prefetch leg — and each steady step holds a new leg."""
+    p_off = _sharded_run(engine, "off", prefetch=False)
+    legs0 = _ctr("hvd_tpu_overlap_prefetch_total")
+    import optax
+    from horovod_tpu.optimizer import DistributedEagerOptimizer
+    engine.config.overlap_pipeline = "staged"
+    engine.config.zero1_prefetch = True
+    engine.replay.invalidate_all("staged test")
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    opt = DistributedEagerOptimizer(optax.sgd(0.1, momentum=0.9),
+                                    sharded=True)
+    state = opt.init(params)
+
+    def loss(p, x):
+        return jnp.sum((x @ p["w"] + p["b"]) ** 2)
+
+    grad_fn = jax.jit(jax.grad(loss))
+    x = jnp.ones((2, 4))
+    for _ in range(4):   # warmup 2 + arm + 1 replayed
+        params, state = opt.update_and_apply(grad_fn(params, x), state,
+                                             params)
+    replayed0 = engine.replay.replayed_steps
+    g = grad_fn(params, x)
+    jax.block_until_ready(g["w"])
+    d0 = engine.dispatch_count
+    params, state = opt.update_and_apply(g, state, params)
+    assert engine.replay.replayed_steps == replayed0 + 1
+    assert engine.dispatch_count - d0 == 2, \
+        "a staged replayed sharded step must be zupd + zag launches"
+    inval0 = _ctr("hvd_tpu_overlap_prefetch_invalidations_total")
+    for _ in range(2):
+        params, state = opt.update_and_apply(grad_fn(params, x), state,
+                                             params)
+    jax.block_until_ready(params["w"])
+    assert _ctr("hvd_tpu_overlap_prefetch_total") - legs0 >= 3
+    # exactly ONE row held between steps (the latest leg), and steady
+    # reuse retires rows WITHOUT counting invalidations — the counter only
+    # sees legs genuinely dropped before reuse
+    assert len(engine._zero1_prefetch) == 1
+    assert _ctr("hvd_tpu_overlap_prefetch_invalidations_total") == inval0
+    # staged trajectory == serial trajectory, bitwise (2 extra steps run
+    # under staged, so only compare against a same-length serial run)
+    p_staged = _sharded_run(engine, "staged")
+    for a, b in zip(jax.tree_util.tree_leaves(p_off),
+                    jax.tree_util.tree_leaves(p_staged)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_staged_replay_honors_prefetch_disabled(engine):
+    """HOROVOD_TPU_ZERO1_PREFETCH=0 under forced "staged" mode: the armed
+    sharded segment stays ONE fused rs->update->ag sub-launch (no zag
+    stage, no held leg) — the documented knob contract holds through
+    replay, not just the eager warmup path."""
+    p_off = _sharded_run(engine, "off", prefetch=False)
+    legs0 = _ctr("hvd_tpu_overlap_prefetch_total")
+    import optax
+    from horovod_tpu.optimizer import DistributedEagerOptimizer
+    engine.config.overlap_pipeline = "staged"
+    engine.config.zero1_prefetch = False
+    engine.replay.invalidate_all("staged no-prefetch test")
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    opt = DistributedEagerOptimizer(optax.sgd(0.1, momentum=0.9),
+                                    sharded=True)
+    state = opt.init(params)
+    grad_fn = jax.jit(jax.grad(
+        lambda p, x: jnp.sum((x @ p["w"] + p["b"]) ** 2)))
+    x = jnp.ones((2, 4))
+    for _ in range(4):
+        params, state = opt.update_and_apply(grad_fn(params, x), state,
+                                             params)
+    g = grad_fn(params, x)
+    jax.block_until_ready(g["w"])
+    replayed0 = engine.replay.replayed_steps
+    d0 = engine.dispatch_count
+    params, state = opt.update_and_apply(g, state, params)
+    assert engine.replay.replayed_steps == replayed0 + 1
+    assert engine.dispatch_count - d0 == 1, \
+        "prefetch off: the staged sharded step must stay one fused launch"
+    assert _ctr("hvd_tpu_overlap_prefetch_total") == legs0, \
+        "prefetch off but a leg was launched"
+    assert not engine._zero1_prefetch
+    for _ in range(1):
+        params, state = opt.update_and_apply(grad_fn(params, x), state,
+                                             params)
+    jax.block_until_ready(params["w"])
+    p_ref = _sharded_run(engine, "off", prefetch=False)
+    del p_ref  # same-length serial rerun keeps the comparison honest
+    for a, b in zip(jax.tree_util.tree_leaves(p_off),
+                    jax.tree_util.tree_leaves(
+                        _sharded_run(engine, "staged", prefetch=False))):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_prefetch_invalidates_on_world_version_bump(engine):
+    """A held prefetch leg must not survive an elastic world-version bump
+    — and the bump must invalidate, not poison: stepping continues and
+    the trajectory stays bitwise equal to the serial path."""
+    import optax
+    from horovod_tpu.optimizer import DistributedEagerOptimizer
+    p_ref = _sharded_run(engine, "off", steps=8, prefetch=False)
+    engine.config.overlap_pipeline = "staged"   # legs ride the staged schedule
+    engine.config.zero1_prefetch = True
+    engine.replay.invalidate_all("bump test")
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    opt = DistributedEagerOptimizer(optax.sgd(0.1, momentum=0.9),
+                                    sharded=True)
+    state = opt.init(params)
+
+    def loss(p, x):
+        return jnp.sum((x @ p["w"] + p["b"]) ** 2)
+
+    grad_fn = jax.jit(jax.grad(loss))
+    x = jnp.ones((2, 4))
+    for _ in range(4):
+        params, state = opt.update_and_apply(grad_fn(params, x), state,
+                                             params)
+    assert engine._zero1_prefetch, "no leg held before the bump"
+    inval0 = _ctr("hvd_tpu_overlap_prefetch_invalidations_total")
+    os.environ["HOROVOD_TPU_WORLD_VERSION"] = str(engine.world_version + 3)
+    for _ in range(4):
+        params, state = opt.update_and_apply(grad_fn(params, x), state,
+                                             params)
+    jax.block_until_ready(params["w"])
+    assert _ctr("hvd_tpu_overlap_prefetch_invalidations_total") > inval0
+    for a, b in zip(jax.tree_util.tree_leaves(p_ref),
+                    jax.tree_util.tree_leaves(params)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.chaos
+def test_overlap_prefetch_failpoint_raises_cleanly(engine):
+    """overlap.prefetch armed with raise(): the prefetch launch failure
+    surfaces as HorovodInternalError (what the elastic loop recovers
+    from), and the NEXT step succeeds — injection must not poison the
+    engine or the held-leg registry."""
+    import optax
+    from horovod_tpu.optimizer import DistributedEagerOptimizer
+    engine.config.overlap_pipeline = "staged"   # legs ride the staged schedule
+    engine.config.zero1_prefetch = True
+    engine.replay.invalidate_all("failpoint test")
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    opt = DistributedEagerOptimizer(optax.sgd(0.1), sharded=True)
+    state = opt.init(params)
+    grad_fn = jax.jit(jax.grad(
+        lambda p, x: jnp.sum((x @ p["w"] + p["b"]) ** 2)))
+    x = jnp.ones((2, 4))
+    faults.arm("overlap.prefetch=1*raise(HorovodInternalError)")
+    try:
+        with pytest.raises(HorovodInternalError):
+            opt.update_and_apply(grad_fn(params, x), state, params)
+    finally:
+        faults.disarm()
+    params, state = opt.update_and_apply(grad_fn(params, x), state, params)
+    jax.block_until_ready(params["w"])
+    assert bool(np.isfinite(np.asarray(params["w"])).all())
+
+
+@pytest.mark.perf
+def test_perf_smoke_pipelined_step_one_iteration(engine):
+    """Tier-1-safe perf smoke (ISSUE 6 CI satellite): build the pipelined
+    replay step and run it ONE iteration on the CPU world — no timing
+    assertions, just that the overlap-mode programs build, launch, and
+    produce the serial path's values."""
+    from horovod_tpu.common.reduce_ops import ReduceOp
+    rng = np.random.RandomState(7)
+    tensors = [jnp.asarray(rng.randn(8, 2).astype(np.float32))
+               for _ in range(6)]
+    engine.config.overlap_pipeline = "interleave"
+    engine.config.fusion_threshold_bytes = 48  # force multiple buckets
+    engine.replay.invalidate_all("perf smoke")
+    out = None
+    for i in range(3):   # 2 warmup + 1 replayed pipelined iteration
+        engine.step_begin()
+        hs = engine.grouped_allreduce(list(tensors), name=f"perf.{i}",
+                                      op=ReduceOp.SUM)
+        out = [np.asarray(h.synchronize()) for h in hs]
+        engine.step_end()
+    assert engine.replay.replayed_steps >= 1
+    for a, b in zip(out, tensors):
+        assert np.array_equal(a, np.asarray(b))
+
+
+def test_apply_xla_lhs_noop_when_backend_live():
+    """In-process: a live jax backend means the flag append would be
+    silently ignored — apply_xla_lhs must WARN and no-op instead."""
+    from horovod_tpu.common.env import apply_xla_lhs
+    jax.devices()  # ensure a backend exists
+    prev_flags = os.environ.get("XLA_FLAGS")
+    os.environ["HOROVOD_TPU_XLA_LHS"] = "1"
+    try:
+        assert apply_xla_lhs() is False
+        assert os.environ.get("XLA_FLAGS") == prev_flags
+    finally:
+        os.environ.pop("HOROVOD_TPU_XLA_LHS", None)
+
+
+def test_apply_xla_lhs_appends_before_backend():
+    """Fresh process, knob set, no jax import yet: the scheduler flag must
+    land in XLA_FLAGS exactly once (idempotent)."""
+    code = (
+        "import os\n"
+        "os.environ['HOROVOD_TPU_XLA_LHS'] = '1'\n"
+        "from horovod_tpu.common.env import apply_xla_lhs\n"
+        "assert apply_xla_lhs() is True\n"
+        "flags = os.environ['XLA_FLAGS']\n"
+        "assert flags.count('xla_tpu_enable_latency_hiding_scheduler') == 1\n"
+        "assert apply_xla_lhs() is True  # idempotent\n"
+        "assert os.environ['XLA_FLAGS'].count("
+        "'xla_tpu_enable_latency_hiding_scheduler') == 1\n"
+        "print('ok')\n")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stderr[-500:]
+    assert "ok" in proc.stdout

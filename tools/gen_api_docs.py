@@ -50,7 +50,9 @@ SECTIONS = [
         "distributed_delta_adasum"]),
     ("Sharded (ZeRO-1) collective builders", "horovod_tpu.ops.collectives", [
         "build_grouped_reducescatter", "build_grouped_allgather",
-        "build_sharded_step", "shard_spec"]),
+        "build_sharded_step", "build_sharded_update", "build_replay_step",
+        "shard_spec"]),
+    ("Comm/compute overlap", "horovod_tpu.common.env", ["apply_xla_lhs"]),
     ("Reduce ops & exceptions", "horovod_tpu", [
         "ReduceOp", "HorovodInternalError", "HostsUpdatedInterrupt",
         "DuplicateNameError"]),

@@ -179,6 +179,54 @@ def critical_path(events: List[dict],
             "wait_by_rank": wait_by_rank}
 
 
+def overlap_report(events: List[dict]) -> dict:
+    """Comm/compute-overlap summary (ISSUE 6): how much wire time sits on
+    the step critical path, and how much of the collectives' in-flight
+    time was hidden off it.
+
+    - ``wire_on_critical_path_pct`` — dispatch (wire-blocking) span time
+      as a fraction of total step time: the share of the step the host/
+      device spent *inside* collective launches instead of math. Lower
+      with overlap on = wire left the critical path.
+    - ``overlap_efficiency_pct`` — 1 − wire_on_cp / collective in-flight
+      time (B→E spans): a collective that is in flight for 10 ms but only
+      blocks the step for 1 ms was 90% hidden. None when the trace has no
+      closed collective spans.
+
+    Driven by ``bench.py`` over the PR 5 trace ring (overlap on vs off,
+    same world, same model) so overlap wins land in the BENCH_r*
+    trajectory and regressions are visible."""
+    wg = wire_vs_gap(events)
+    total_us = sum(r["total_us"] for r in wg.values())
+    wire_us = sum(r["wire_us"] for r in wg.values())
+    opens: Dict[Tuple[int, str], float] = {}
+    inflight_us = 0.0
+    spans = 0
+    for ev in events:
+        corr = _corr_of(ev)
+        if corr is None:
+            continue
+        pid = int(ev.get("pid", 0))
+        if ev.get("ph") == "B":
+            opens[(pid, corr)] = float(ev.get("ts", 0.0))
+        elif ev.get("ph") == "E":
+            t0 = opens.pop((pid, corr), None)
+            if t0 is not None:
+                inflight_us += max(float(ev.get("ts", 0.0)) - t0, 0.0)
+                spans += 1
+    return {
+        "total_us": total_us,
+        "wire_us": wire_us,
+        "inflight_us": inflight_us,
+        "collective_spans": spans,
+        "wire_on_critical_path_pct": (
+            round(100.0 * wire_us / total_us, 2) if total_us > 0 else None),
+        "overlap_efficiency_pct": (
+            round(100.0 * max(0.0, 1.0 - wire_us / inflight_us), 2)
+            if inflight_us > 0 else None),
+    }
+
+
 def analyze(events: List[dict]) -> dict:
     """The full report as a plain dict (what ``main`` prints; tests and
     notebooks call this directly)."""
@@ -194,6 +242,7 @@ def analyze(events: List[dict]) -> dict:
         "top_straggler": ranking[0]["rank"] if ranking else None,
         "wire_vs_gap": wire_vs_gap(events),
         "critical_path": critical_path(events, skews),
+        "overlap": overlap_report(events),
     }
 
 
