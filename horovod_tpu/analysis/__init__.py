@@ -1,0 +1,41 @@
+"""Static-analysis library for the horovod_tpu runtime (ISSUE 7).
+
+The runtime is a background-thread system: engine cycle loop, replay,
+elastic discovery/resume threads, stall inspector, trace/metrics
+publishers — all sharing mutable state through ``threading.Lock``-guarded
+attributes. PRs 3-5 established the repo's correctness-tooling idiom
+(centrally declared names linted by a script run from a tier-1 test);
+this package extends it from *names* to *behavior*:
+
+- :mod:`.lockcheck` — a Clang Thread-Safety-Analysis-style GUARDED_BY
+  checker for Python: classes declare which attributes a lock guards
+  (``_GUARDED_BY`` class attribute or ``# guarded_by:`` trailing
+  comments), and an AST pass reports every off-lock access, lock-order
+  inversion, blocking call made under a lock, and thread target touching
+  unannotated shared state. Suppressions are inline
+  (``# lockcheck: ignore[reason]``), counted, and must carry a reason.
+- :mod:`.knobcheck` — the configuration-knob registry lint: every
+  ``HOROVOD_*`` environment variable read under ``horovod_tpu/`` must be
+  declared in :data:`horovod_tpu.common.knobs.KNOB_SPECS` (and every
+  declared knob must actually be read somewhere).
+
+Both are pure-stdlib AST passes (no runtime import of the modules they
+scan). ``tools/check.py`` is the unified driver that runs them next to
+the metric-name, fault-name, and trace-schema lints as one command with
+one machine-readable report; see ``docs/static_analysis.md``.
+"""
+
+import os
+from typing import Iterator
+
+
+def iter_py_files(root: str) -> Iterator[str]:
+    """Every ``.py`` file under ``root`` (sorted, ``__pycache__``
+    skipped) — the one traversal every analysis pass shares, so
+    encoding/ordering semantics can't drift between lints."""
+    for dirpath, _dirs, names in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for name in sorted(names):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)

@@ -1,0 +1,179 @@
+"""Configuration-knob registry lint: every ``HOROVOD_*`` environment
+variable read under ``horovod_tpu/`` must be declared in
+:data:`horovod_tpu.common.knobs.KNOB_SPECS`, and every declared knob must
+actually be read somewhere (no dead knobs).
+
+The scan is a pure-AST pass (no module under scan is imported). A "read"
+is the first argument of:
+
+- ``os.environ.get(...)`` / ``os.environ[...]`` (Load context) /
+  ``os.getenv(...)``
+- the ``common/env.py`` typed helpers ``_get_bool`` / ``_get_int`` /
+  ``_get_float`` / ``_get_choice``
+
+where the argument is a string literal or a name/attribute resolvable
+through the constants table in ``horovod_tpu/common/env.py`` (the
+``HOROVOD_X = "HOROVOD_X"`` block). Arguments that stay symbolic (e.g.
+the ``name`` parameter inside the helpers themselves) are ignored.
+
+``tools/check.py`` runs this next to the other lints;
+``tools/gen_api_docs.py`` renders the registry as the generated
+"Configuration knobs" section of docs/api.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+KNOB_NAME_RE = re.compile(r"^HOROVOD(_TPU)?(_[A-Z0-9]+)+$")
+VALID_TYPES = ("bool", "int", "float", "str", "choice", "spec")
+
+_READ_HELPERS = ("_get_bool", "_get_int", "_get_float", "_get_choice")
+
+
+def _const_table(env_py_path: str) -> Dict[str, str]:
+    """``HOROVOD_X = "HOROVOD_X"`` module-level assignments in
+    common/env.py — the indirection every ``env_mod.HOROVOD_X`` read
+    site goes through."""
+    with open(env_py_path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    table: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            table[node.targets[0].id] = node.value.value
+    return table
+
+
+def _resolve(arg: ast.expr, consts: Dict[str, str]) -> Optional[str]:
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.Name):
+        return consts.get(arg.id)
+    if isinstance(arg, ast.Attribute):       # env_mod.HOROVOD_X
+        return consts.get(arg.attr)
+    return None
+
+
+def _is_environ(node: ast.expr) -> bool:
+    """``os.environ`` / bare ``environ`` / ``_os.environ``."""
+    return (isinstance(node, ast.Attribute) and node.attr == "environ") or \
+        (isinstance(node, ast.Name) and node.id == "environ")
+
+
+def scan_env_reads(pkg_root: str,
+                   errors: Optional[List[str]] = None
+                   ) -> List[Tuple[str, int, str]]:
+    """Every resolvable env-var read under ``pkg_root``:
+    (relpath, lineno, var name). Only ``HOROVOD*`` names are returned.
+    Files that fail to parse are reported into ``errors`` (when given)
+    instead of silently dropping their read sites — a skipped file would
+    turn an undeclared read invisible and a declared one "dead"."""
+    consts = _const_table(os.path.join(pkg_root, "common", "env.py"))
+    sites: List[Tuple[str, int, str]] = []
+
+    def note(rel: str, node: ast.AST, arg: ast.expr):
+        name = _resolve(arg, consts)
+        if name and name.startswith("HOROVOD"):
+            sites.append((rel, node.lineno, name))
+
+    from . import iter_py_files
+    for path in iter_py_files(pkg_root):
+        rel = os.path.relpath(path, pkg_root)
+        with open(path, encoding="utf-8", errors="replace") as f:
+            try:
+                tree = ast.parse(f.read())
+            except SyntaxError as e:
+                if errors is not None:
+                    errors.append(
+                        f"{rel}:{e.lineno or 0}: could not parse "
+                        f"({e.msg}) — its env reads are invisible "
+                        f"to this lint")
+                continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                func = node.func
+                if isinstance(func, ast.Attribute) and \
+                        func.attr in ("get", "getenv", "pop",
+                                      "setdefault") and \
+                        (_is_environ(func.value) or
+                         (func.attr == "getenv" and
+                          isinstance(func.value, ast.Name))):
+                    if node.args:
+                        note(rel, node, node.args[0])
+                elif isinstance(func, ast.Name) and \
+                        func.id in ("getenv",) + _READ_HELPERS:
+                    if node.args:
+                        note(rel, node, node.args[0])
+                elif isinstance(func, ast.Attribute) and \
+                        func.attr in _READ_HELPERS:
+                    if node.args:
+                        note(rel, node, node.args[0])
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    _is_environ(node.value):
+                note(rel, node, node.slice)
+    return sites
+
+
+def validate_specs(specs: Dict[str, dict]) -> List[str]:
+    """Registry shape lint: names match the knob regex, every entry has a
+    valid type and a non-empty help string."""
+    errors = []
+    for name, spec in sorted(specs.items()):
+        if not KNOB_NAME_RE.match(name):
+            errors.append(f"{name}: does not match {KNOB_NAME_RE.pattern}")
+        if not isinstance(spec, dict):
+            errors.append(f"{name}: spec must be a dict "
+                          f"(type/default/help)")
+            continue
+        if spec.get("type") not in VALID_TYPES:
+            errors.append(f"{name}: unknown knob type {spec.get('type')!r} "
+                          f"(valid: {', '.join(VALID_TYPES)})")
+        help_str = spec.get("help")
+        if not isinstance(help_str, str) or not help_str.strip():
+            errors.append(f"{name}: missing help string")
+        if spec.get("type") == "choice" and not spec.get("choices"):
+            errors.append(f"{name}: choice knobs must list choices")
+    return errors
+
+
+def validate_reads(specs: Dict[str, dict],
+                   sites: List[Tuple[str, int, str]]) -> List[str]:
+    """Undeclared reads + dead (declared-but-unread) knobs."""
+    errors = []
+    for rel, lineno, name in sites:
+        if name not in specs:
+            errors.append(
+                f"{rel}:{lineno}: env var {name!r} is read but not "
+                f"declared in horovod_tpu.common.knobs.KNOB_SPECS")
+    read = {name for _, _, name in sites}
+    # export-only knobs are part of the worker env contract: the framework
+    # sets them for subprocesses but never reads them back
+    declared = {n for n, s in specs.items()
+                if not (isinstance(s, dict) and s.get("export"))}
+    for name in sorted(declared - read):
+        errors.append(
+            f"KNOB_SPECS declares {name!r} but nothing under horovod_tpu/ "
+            f"reads it (dead knob — remove it or wire it up)")
+    return errors
+
+
+def run(pkg_root: Optional[str] = None) -> Tuple[List[str], dict]:
+    """The full lint: (errors, stats). ``stats`` carries the scan size so
+    the driver's report shows coverage, not just a green light."""
+    if pkg_root is None:
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    from ..common.knobs import KNOB_SPECS
+    errors: List[str] = []
+    sites = scan_env_reads(pkg_root, errors=errors)
+    errors += validate_specs(KNOB_SPECS)
+    errors += validate_reads(KNOB_SPECS, sites)
+    stats = {"declared": len(KNOB_SPECS), "read_sites": len(sites),
+             "distinct_read": len({n for _, _, n in sites})}
+    return errors, stats

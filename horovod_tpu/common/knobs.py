@@ -1,0 +1,341 @@
+"""Central configuration-knob registry (ISSUE 7 satellite).
+
+Every ``HOROVOD_*`` / ``HOROVOD_TPU_*`` environment variable the
+framework reads is declared here: name -> ``{"type", "default", "help"}``
+(plus ``"choices"`` for choice knobs, ``"internal": True`` for plumbing
+variables the launcher/rendezvous sets rather than users, and
+``"export": True`` for variables the framework only *sets* for worker
+processes as part of the env contract).
+
+The registry is linted by :mod:`horovod_tpu.analysis.knobcheck` (run
+from ``tools/check.py`` and a tier-1 test): an AST scan of every
+``os.environ`` / ``getenv`` / typed-helper read under ``horovod_tpu/``
+fails on **undeclared** reads (a knob someone added without documenting)
+and on **dead** declarations (a knob nothing reads any more). The
+"Configuration knobs" section of ``docs/api.md`` is generated from this
+table by ``tools/gen_api_docs.py`` — docs, code, and lint share one
+source of truth, the ``METRIC_SPECS`` / ``FAULT_SPECS`` discipline
+applied to the env plane.
+
+``default`` records the *effective* default as a display string
+("derived" when computed from topology/context at runtime). Parsing
+stays where it always was (``common/env.py`` helpers and the call
+sites); this table adds no runtime indirection.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+KNOB_SPECS: Dict[str, dict] = {
+    # -- core engine / fusion (parity: common.h:64-90) ----------------------
+    "HOROVOD_FUSION_THRESHOLD": {
+        "type": "int", "default": str(64 * 1024 * 1024),
+        "help": "Fusion-buffer bucket size in bytes for grouped/sharded "
+                "collectives (operations.cc:432 parity); autotunable."},
+    "HOROVOD_CYCLE_TIME": {
+        "type": "float", "default": "5.0",
+        "help": "Engine cycle-loop wake interval in ms (handle "
+                "retirement cadence); autotunable."},
+    "HOROVOD_CACHE_CAPACITY": {
+        "type": "int", "default": "1024",
+        "help": "LRU capacity of the engine builder cache and the "
+                "steady-state metadata cache (ResponseCache analog)."},
+    "HOROVOD_JOIN_DISABLE": {
+        "type": "bool", "default": "0",
+        "help": "Disable the per-op Join advertisement round (shaves one "
+                "fire-and-forget exchange per op when no rank can run out "
+                "of data early)."},
+    "HOROVOD_JOIN_META_SLOTS": {
+        "type": "int", "default": "16",
+        "help": "Inline metadata slots in the fixed-shape join round; "
+                "larger grouped calls spill into one overflow exchange."},
+    "HOROVOD_HIERARCHICAL_ALLREDUCE": {
+        "type": "bool", "default": "0",
+        "help": "Two-level intra/inter-node allreduce when the topology "
+                "has a non-trivial homogeneous factorization."},
+    "HOROVOD_HIERARCHICAL_ALLGATHER": {
+        "type": "bool", "default": "0",
+        "help": "Two-level intra/inter-node allgather (local gather, "
+                "cross exchange, local fan-out)."},
+    "HOROVOD_TPU_SINGLE_LAUNCH": {
+        "type": "bool", "default": "1",
+        "help": "Service a grouped allreduce as one pack launch plus one "
+                "reduce+unpack program; =0 restores the per-bucket "
+                "two-dispatch form."},
+    "HOROVOD_TPU_META_CACHE": {
+        "type": "bool", "default": "1",
+        "help": "Steady-state size-negotiation cache for unequal "
+                "allgather/alltoall: hot entries skip the blocking "
+                "exchange with a deferred extract-time check."},
+    "HOROVOD_TPU_META_CACHE_WARMUP": {
+        "type": "int", "default": "2",
+        "help": "Identical world observations before a size-cache entry "
+                "goes hot (fire-and-forget exchanges)."},
+    "HOROVOD_TPU_DEBUG_CONSISTENCY": {
+        "type": "bool", "default": "0",
+        "help": "Allgather a submission fingerprint before every "
+                "collective and raise descriptive cross-rank mismatch "
+                "errors (controller.cc:380-623 debug mode)."},
+    # -- step-capture replay ------------------------------------------------
+    "HOROVOD_TPU_STEP_REPLAY": {
+        "type": "bool", "default": "1",
+        "help": "Record the dispatch stream between step markers and "
+                "service steady-state steps as one fused XLA launch."},
+    "HOROVOD_TPU_STEP_REPLAY_WARMUP": {
+        "type": "int", "default": "3",
+        "help": "Identical step signatures required before a replay "
+                "stream arms."},
+    # -- comm/compute overlap (ISSUE 6) -------------------------------------
+    "HOROVOD_TPU_OVERLAP_PIPELINE": {
+        "type": "choice", "default": "auto",
+        "choices": ("auto", "off", "interleave", "staged"),
+        "help": "Collective schedule of the fused step: serial chain, "
+                "back-to-back interleave, per-bucket staged sub-launches, "
+                "or auto per (bytes, topology)."},
+    "HOROVOD_TPU_OVERLAP_STAGE_BYTES": {
+        "type": "int", "default": str(8 * 1024 * 1024),
+        "help": "Auto mode switches interleave -> staged when a step's "
+                "gradient bytes reach this threshold."},
+    "HOROVOD_TPU_ZERO1_PREFETCH": {
+        "type": "bool", "default": "1",
+        "help": "Split the ZeRO-1 step so the parameter all-gather rides "
+                "as its own prefetch leg under the step tail (staged "
+                "schedule only)."},
+    "HOROVOD_TPU_XLA_LHS": {
+        "type": "bool", "default": "0",
+        "help": "Append --xla_tpu_enable_latency_hiding_scheduler=true "
+                "to XLA_FLAGS before the first backend touch."},
+    # -- ZeRO-1 sharded optimizer -------------------------------------------
+    "HOROVOD_TPU_SHARD_OPTIMIZER": {
+        "type": "bool", "default": "0",
+        "help": "Default for optimizers constructed with sharded=None: "
+                "bucketed reduce-scatter -> shard-local update -> fused "
+                "all-gather (optimizer state / world size)."},
+    # -- autotune -----------------------------------------------------------
+    "HOROVOD_AUTOTUNE": {
+        "type": "bool", "default": "0",
+        "help": "Enable the Bayesian autotuner over fusion threshold, "
+                "cycle time, and the categorical knobs."},
+    "HOROVOD_AUTOTUNE_LOG": {
+        "type": "str", "default": "",
+        "help": "CSV file receiving one line per autotune sample."},
+    "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": {
+        "type": "int", "default": "3",
+        "help": "Discarded warmup samples before scoring begins."},
+    "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE": {
+        "type": "int", "default": "10",
+        "help": "Steps aggregated into one autotune throughput sample."},
+    "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES": {
+        "type": "int", "default": "20",
+        "help": "Samples before the tuner converges on the best knob "
+                "setting."},
+    "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE": {
+        "type": "float", "default": "0.8",
+        "help": "GP noise prior for the Bayesian optimizer."},
+    # -- stall inspector / collective watchdog ------------------------------
+    "HOROVOD_STALL_CHECK_DISABLE": {
+        "type": "bool", "default": "0",
+        "help": "Disable stall warning/shutdown tiers (the collective "
+                "watchdog still arms when a deadline is set)."},
+    "HOROVOD_STALL_CHECK_TIME_SECONDS": {
+        "type": "float", "default": "60.0",
+        "help": "Outstanding-op age before a stall warning "
+                "(stall_inspector.h:75 parity)."},
+    "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": {
+        "type": "float", "default": "0.0",
+        "help": "Outstanding-op age before the process aborts (0 "
+                "disables; the terminal tier for hangs with no Python "
+                "edge left)."},
+    "HOROVOD_TPU_COLLECTIVE_DEADLINE": {
+        "type": "float", "default": "0.0",
+        "help": "Seconds a collective may sit outstanding (or a peer "
+                "heartbeat lag) before the watchdog poisons the engine "
+                "and raises the elastic-recoverable error; 0 disables."},
+    # -- metrics & telemetry ------------------------------------------------
+    "HOROVOD_TPU_METRICS": {
+        "type": "bool", "default": "1",
+        "help": "Master switch for the metrics registry; =0 makes every "
+                "instrument a shared lock-free no-op."},
+    "HOROVOD_TPU_METRICS_FILE": {
+        "type": "str", "default": "",
+        "help": "JSONL file the periodic metrics emitter appends "
+                "snapshots to."},
+    "HOROVOD_TPU_METRICS_INTERVAL": {
+        "type": "float", "default": "10.0",
+        "help": "Seconds between metrics emitter ticks (JSONL / KV "
+                "publish / timeline counter samples)."},
+    # -- cross-rank tracing -------------------------------------------------
+    "HOROVOD_TPU_TRACE": {
+        "type": "bool", "default": "1",
+        "help": "Cross-rank collective tracing; =0 leaves engine.trace "
+                "None (no per-dispatch locking)."},
+    "HOROVOD_TPU_TRACE_RING": {
+        "type": "int", "default": "4096",
+        "help": "Per-rank in-memory trace ring capacity (events)."},
+    "HOROVOD_TPU_TRACE_INTERVAL": {
+        "type": "float", "default": "5.0",
+        "help": "Seconds between trace-segment KV publishes and clock "
+                "beacons."},
+    "HOROVOD_TPU_TRACE_DUMP_DIR": {
+        "type": "str", "default": "",
+        "help": "Directory for the watchdog's flight-recorder trace dump "
+                "(hvd_tpu_flight_rank<r>.json)."},
+    # -- timeline -----------------------------------------------------------
+    "HOROVOD_TIMELINE": {
+        "type": "str", "default": "",
+        "help": "Chrome-trace timeline output path (rank>0 suffixes "
+                ".rank<r>)."},
+    "HOROVOD_TIMELINE_MARK_CYCLES": {
+        "type": "bool", "default": "0",
+        "help": "Mark engine cycle boundaries in the timeline."},
+    "HOROVOD_TIMELINE_NATIVE": {
+        "type": "bool", "default": "1",
+        "help": "Use the native timeline writer when available; =0 "
+                "forces the pure-Python writer."},
+    # -- fault injection ----------------------------------------------------
+    "HOROVOD_TPU_FAULTS": {
+        "type": "spec", "default": "",
+        "help": "Failpoint spec string "
+                "(name[@rank]=N*action(args)->..., docs/"
+                "fault_tolerance.md); unset leaves every failpoint a "
+                "no-op."},
+    # -- elastic ------------------------------------------------------------
+    "HOROVOD_ELASTIC": {
+        "type": "bool", "default": "0",
+        "help": "Elastic mode: tighter failure-detection timeouts and "
+                "re-rendezvous on membership changes."},
+    "HOROVOD_ELASTIC_TIMEOUT": {
+        "type": "float", "default": "600",
+        "help": "Seconds to wait for the elastic world to (re)form "
+                "before giving up (falls back to "
+                "HOROVOD_GLOO_TIMEOUT_SECONDS)."},
+    "HOROVOD_ELASTIC_MAX_RUNTIME_RETRIES": {
+        "type": "int", "default": "3",
+        "help": "Consecutive raw-runtime failures the elastic run-loop "
+                "recovers before escalating (resets on commit "
+                "progress)."},
+    "HOROVOD_ELASTIC_FAILURE_BACKOFF": {
+        "type": "float", "default": "5.0",
+        "help": "Base seconds a repeatedly-failing slot is suspended "
+                "before re-admission (doubles per strike)."},
+    "HOROVOD_ELASTIC_SLOT_FAILURE_LIMIT": {
+        "type": "int", "default": "4",
+        "help": "Slot failure strikes before the host is blacklisted "
+                "for good."},
+    # -- attention / Pallas kernels -----------------------------------------
+    "HOROVOD_SPLASH": {
+        "type": "choice", "default": "1", "choices": ("0", "1", "force"),
+        "help": "Splash-attention kernel for local attention: 0 off, 1 "
+                "auto (falls back off-TPU), force (raise when "
+                "unavailable)."},
+    "HOROVOD_SPLASH_VMEM_LIMIT": {
+        "type": "int", "default": str(16 * 1024 * 1024),
+        "help": "Scoped VMEM budget (bytes) the splash kernel compiles "
+                "against."},
+    "HOROVOD_SPLASH_BLOCK_KV": {
+        "type": "int", "default": "2048",
+        "help": "Preferred KV block size for the splash kernel."},
+    "HOROVOD_RING_PALLAS": {
+        "type": "bool", "default": "1",
+        "help": "Pallas blockwise kernel inside ring attention; =0 "
+                "forces the pure-JAX fallback."},
+    "HOROVOD_RING_CHUNK": {
+        "type": "int", "default": "512",
+        "help": "KV chunk rows per ring-attention step."},
+    "HOROVOD_RING_SEG_BLOCK": {
+        "type": "int", "default": "1024",
+        "help": "Preferred segment block size for the ring-attention "
+                "Pallas kernel."},
+    "HOROVOD_ADASUM_PALLAS": {
+        "type": "bool", "default": "0",
+        "help": "Pallas fused dot/norm kernel inside Adasum combine "
+                "(TPU only)."},
+    "HOROVOD_PALLAS_PACK": {
+        "type": "bool", "default": "0",
+        "help": "Pallas fusion-buffer pack kernel for grouped "
+                "collectives (also an autotune categorical)."},
+    # -- logging ------------------------------------------------------------
+    "HOROVOD_LOG_LEVEL": {
+        "type": "str", "default": "warning",
+        "help": "Framework log level (trace/debug/info/warning/error/"
+                "fatal)."},
+    # -- launcher / rendezvous plumbing (set by tpurun & the elastic
+    #    driver; users rarely set these directly) ---------------------------
+    "HOROVOD_GLOO_RENDEZVOUS_ADDR": {
+        "type": "str", "default": "", "internal": True,
+        "help": "Rendezvous/KV server address the launcher hands to "
+                "workers."},
+    "HOROVOD_GLOO_RENDEZVOUS_PORT": {
+        "type": "int", "default": "", "internal": True,
+        "help": "Rendezvous/KV server port."},
+    "HOROVOD_GLOO_TIMEOUT_SECONDS": {
+        "type": "float", "default": "600", "internal": True,
+        "help": "Rendezvous long-poll / KV operation timeout."},
+    "HOROVOD_GLOO_IFACE": {
+        "type": "str", "default": "", "internal": True,
+        "help": "Network interface advertised for worker-to-worker "
+                "control connections."},
+    "HOROVOD_HOSTNAME": {
+        "type": "str", "default": "derived", "internal": True,
+        "help": "This worker's hostname as assigned by the launcher."},
+    "HOROVOD_RANK": {
+        "type": "int", "default": "0", "internal": True,
+        "help": "This worker's world rank (launcher-assigned)."},
+    "HOROVOD_SIZE": {
+        "type": "int", "default": "derived", "internal": True,
+        "export": True,
+        "help": "World size, exported to worker environments (the "
+                "framework itself reads HOROVOD_TPU_NUM_PROCESSES)."},
+    "HOROVOD_LOCAL_RANK": {
+        "type": "int", "default": "0", "internal": True,
+        "help": "Rank within this host."},
+    "HOROVOD_LOCAL_SIZE": {
+        "type": "int", "default": "1", "internal": True,
+        "help": "Workers on this host."},
+    "HOROVOD_CROSS_RANK": {
+        "type": "int", "default": "derived", "internal": True,
+        "help": "This host's index across hosts."},
+    "HOROVOD_CROSS_SIZE": {
+        "type": "int", "default": "derived", "internal": True,
+        "help": "Number of hosts."},
+    "HOROVOD_TASK_SECRET": {
+        "type": "str", "default": "", "internal": True,
+        "help": "Hex job secret signing task-agent RPCs (stripped from "
+                "worker environments)."},
+    "HOROVOD_TPU_SHARED_FS": {
+        "type": "bool", "default": "0", "internal": True,
+        "help": "Acknowledge that the programmatic-run tempdir is on a "
+                "filesystem shared by every remote host."},
+    "HOROVOD_TPU_COORDINATOR": {
+        "type": "str", "default": "", "internal": True,
+        "help": "host:port of the JAX distributed coordinator."},
+    "HOROVOD_TPU_NUM_PROCESSES": {
+        "type": "int", "default": "derived", "internal": True,
+        "help": "Process count for jax.distributed.initialize."},
+    "HOROVOD_TPU_PROCESS_ID": {
+        "type": "int", "default": "derived", "internal": True,
+        "help": "This process's id for jax.distributed.initialize "
+                "(falls back to HOROVOD_RANK)."},
+    "HOROVOD_TPU_WORLD_VERSION": {
+        "type": "int", "default": "0", "internal": True,
+        "help": "Elastic world version the rendezvous stamps on every "
+                "re-init; replay and prefetch invalidate when it bumps."},
+    "HOROVOD_TPU_PLATFORM": {
+        "type": "str", "default": "", "internal": True,
+        "help": "Backend platform override (cpu|tpu) for tests and "
+                "dryruns."},
+    "HOROVOD_TPU_HEARTBEAT_TIMEOUT": {
+        "type": "int", "default": "100 (10 when elastic)",
+        "internal": True,
+        "help": "Coordination-service heartbeat timeout in seconds."},
+    "HOROVOD_TPU_SHUTDOWN_TIMEOUT": {
+        "type": "int", "default": "300 (30 when elastic)",
+        "internal": True,
+        "help": "Coordination-service shutdown timeout in seconds."},
+    "HOROVOD_TPU_SHUTDOWN_ORDER_TIMEOUT": {
+        "type": "float", "default": "10", "internal": True,
+        "help": "Seconds rank 0 waits for peers' disconnect flags before "
+                "shutting the coordination service (coordinator-last "
+                "teardown)."},
+}

@@ -84,12 +84,15 @@ class LaunchGroup:
 
     __slots__ = ("_rep", "_done", "_lock")
 
+    _GUARDED_BY = {"_done": "_lock"}
+
     def __init__(self, representative: jax.Array):
         self._rep = representative
         self._done = False
         self._lock = threading.Lock()
 
     def ready(self) -> bool:
+        # lockcheck: ignore[double-checked fast path: _done only transitions False->True, a stale read just re-polls]
         if self._done:
             return True
         if hasattr(self._rep, "is_ready"):
@@ -97,13 +100,16 @@ class LaunchGroup:
         else:  # older jax without is_ready
             ok = True
         if ok:
+            # lockcheck: ignore[monotonic latch: concurrent True writes are idempotent]
             self._done = True
         return ok
 
     def wait(self):
+        # lockcheck: ignore[double-checked fast path: a stale read falls through to the locked re-check]
         if not self._done:
             with self._lock:
                 if not self._done:
+                    # lockcheck: ignore[deliberate: the lock serializes waiters so one blocks and the rest inherit completion]
                     _translate_failure(self._rep.block_until_ready)
                     self._done = True
 
@@ -223,6 +229,8 @@ class Handle:
 class HandleManager:
     """int handle -> Handle map (parity: torch/handle_manager.{h,cc})."""
 
+    _GUARDED_BY = {"_next": "_lock", "_handles": "_lock"}
+
     def __init__(self):
         self._lock = threading.Lock()
         self._next = 0
@@ -285,6 +293,17 @@ def _join_meta_row(x, op_or_root: int) -> np.ndarray:
 
 
 class Engine:
+    # lock discipline (tools/check.py lockcheck): the outstanding-op table
+    # is shared between the user thread, the cycle loop, and handle
+    # completion; the ZeRO-1 prefetch registry is mutated by the dispatch
+    # path and invalidated from replay/join/elastic edges. Everything else
+    # on the engine (builders, meta cache, replay state, counters) is
+    # dispatching-thread-only by design (see StepReplay's docstring).
+    _GUARDED_BY = {
+        "_outstanding": "_lock",
+        "_zero1_prefetch": "_lock",
+    }
+
     def __init__(self, backend: Backend, config: env_mod.Config):
         self.backend = backend
         self.config = config
@@ -418,6 +437,7 @@ class Engine:
             raise self._poison
 
     def _cycle_loop(self):
+        # lockcheck: ignore[single-writer shutdown flag: stop() only transitions it True->False, a stale read costs one extra tick]
         while self._running:
             # cycle time is re-read every iteration so the autotuner can
             # retune it live (parameter_manager.h:178-220)
@@ -610,18 +630,25 @@ class Engine:
         computed from the leg's gathered params, i.e. the leg was reused,
         so ``hvd_tpu_overlap_prefetch_invalidations_total`` only ever
         counts legs genuinely dropped before reuse."""
-        self._zero1_prefetch[update_key] = {
-            "world_version": self.world_version}
+        # The registry is written here on the dispatch path but cleared
+        # from replay/join/elastic invalidation edges that can run on the
+        # worker-notification or watchdog threads — the unguarded dict
+        # raced its own invalidation sweep (lockcheck off-lock-access
+        # regression, tests/test_race_regressions.py).
+        with self._lock:
+            self._zero1_prefetch[update_key] = {
+                "world_version": self.world_version}
         self._m_prefetch.inc()
 
     def invalidate_prefetch(self, reason: str) -> None:
         """Drop every held prefetch leg (the replay-invalidation contract
         applied to the prefetch subsystem: invalidate, never poison — the
         next sharded step simply re-gathers)."""
-        if not self._zero1_prefetch:
+        with self._lock:
+            dropped = len(self._zero1_prefetch)
+            self._zero1_prefetch.clear()
+        if not dropped:
             return
-        dropped = len(self._zero1_prefetch)
-        self._zero1_prefetch.clear()
         self._m_prefetch_inval.inc(dropped)
         self._emit_replay("prefetch-invalidate", reason)
 
@@ -629,10 +656,11 @@ class Engine:
         """Drop held legs whose world version is stale (an elastic bump
         observed outside the replay step markers)."""
         v = self.world_version
-        stale = [k for k, ent in self._zero1_prefetch.items()
-                 if ent["world_version"] != v]
-        for k in stale:
-            del self._zero1_prefetch[k]
+        with self._lock:
+            stale = [k for k, ent in self._zero1_prefetch.items()
+                     if ent["world_version"] != v]
+            for k in stale:
+                del self._zero1_prefetch[k]
         if stale:
             self._m_prefetch_inval.inc(len(stale))
             self._emit_replay("prefetch-invalidate",
@@ -1277,7 +1305,8 @@ class Engine:
         # gathered params — that leg was REUSED, so retire its registry row
         # (after the gc above, which must still count bump-stranded rows):
         # invalidation counters only ever see legs dropped before this point
-        self._zero1_prefetch.pop(update_key, None)
+        with self._lock:
+            self._zero1_prefetch.pop(update_key, None)
         mode = self._overlap_mode(sum(t.nbytes for t in tensors),
                                   len(buckets), sharded=True)
         # the split leg is a property of the STAGED schedule — the one

@@ -176,7 +176,15 @@ class _Armed(NamedTuple):
 class StepReplay:
     """Per-engine capture/replay state machine. All mutation happens on the
     dispatching (user) thread; the cycle thread only polls the tracked
-    representative handle."""
+    representative handle.
+
+    Lock discipline (tools/check.py lockcheck): deliberately NO locks and
+    no ``_GUARDED_BY`` — the single-thread confinement above is the
+    synchronization. The engine state replay touches from other threads'
+    edges (the ZeRO-1 prefetch registry it invalidates, the outstanding
+    table its launches ride) is guarded on the Engine side; anything added
+    here that a background thread must touch belongs on the engine with an
+    annotation, not in this class."""
 
     def __init__(self, engine):
         self.engine = engine

@@ -56,6 +56,21 @@ DEFAULT_SLOT_FAILURE_LIMIT = 4
 
 
 class ElasticDriver:
+    # lock discipline (tools/check.py lockcheck): world state is written
+    # by the discovery thread, resume threads, and the rendezvous/process-
+    # monitor callbacks — everything below rides the one RLock. _m_events
+    # is a metrics EventLog with its own internal lock.
+    _GUARDED_BY = {
+        "_assignments": "_lock",
+        "_started_slots": "_lock",
+        "_pending_resume": "_lock",
+        "_results": "_lock",
+        "_slot_strikes": "_lock",
+        "_error_message": "_lock",
+        "_world_version": "_lock",
+        "_m_events": "<internal>",
+    }
+
     def __init__(self, rendezvous, discovery: HostDiscovery, min_np: int,
                  max_np: Optional[int] = None,
                  timeout: Optional[float] = None,
@@ -131,10 +146,12 @@ class ElasticDriver:
 
     @property
     def error_message(self) -> Optional[str]:
-        return self._error_message
+        with self._lock:
+            return self._error_message
 
     def get_results(self) -> Dict[str, Tuple[object, int]]:
-        return dict(self._results)
+        with self._lock:
+            return dict(self._results)
 
     @property
     def host_manager(self) -> HostManager:
@@ -146,7 +163,8 @@ class ElasticDriver:
 
     @property
     def world_version(self) -> int:
-        return self._world_version
+        with self._lock:
+            return self._world_version
 
     def world_size(self) -> int:
         with self._lock:
@@ -315,6 +333,7 @@ class ElasticDriver:
                 f"v{self._world_version} workers={len(assignments)} "
                 f"started={len(pending)}")
         for s in pending:
+            # lockcheck: ignore[_create_worker_fn is assigned once in start() before any driver thread exists]
             self._create_worker_fn(s)
 
     def resume(self):
@@ -416,7 +435,12 @@ class ElasticDriver:
                            result=None):
         """Called by the launcher's process monitor on worker termination."""
         key = f"{host}:{local_rank}"
-        self._results[key] = (result, exit_code)
+        # under the lock: process monitors run on their own threads, and
+        # an unguarded dict write here raced _maybe_finish_on_success /
+        # _activate_workers reading the results table (lockcheck
+        # off-lock-access regression, tests/test_race_regressions.py)
+        with self._lock:
+            self._results[key] = (result, exit_code)
         self._m_events.append("rank_leave", f"{key} exit={exit_code}")
         if exit_code == 0:
             with self._lock:
@@ -455,6 +479,7 @@ class ElasticDriver:
                 self._m_events.append("blacklist", host)
             self._registry.record_failure(host, local_rank)
 
+    # requires: _lock
     def _record_slot_strike(self, key: str):
         """Failure accounting for graceful degradation (called under
         ``self._lock``): the first failure in the strike window is free

@@ -67,12 +67,42 @@ class WorkerNotificationManager:
     registers the worker's address with the rendezvous, and fans events out
     to registered listeners (reference worker.py:24-83)."""
 
+    _GUARDED_BY = {"_reg_epoch": "_lock"}
+
     def __init__(self):
         self._lock = threading.Lock()
+        # Serializes the registration PUTs of init()/reregister() without
+        # holding the manager lock through network I/O: a slow rendezvous
+        # must not wedge listener registration or the driver's membership
+        # push (manager-lock users). Serialization alone cannot ORDER the
+        # PUTs, so each registration bumps _reg_epoch and a PUT holding
+        # _put_lock first re-checks its epoch is still current — a
+        # delayed init PUT superseded by a reregister skips instead of
+        # re-advertising a stale rank key.
+        self._put_lock = threading.Lock()
+        self._reg_epoch = 0
         self._service: Optional[WorkerNotificationService] = None
         self._listeners: List[object] = []
         self._rdv: Optional[tuple] = None       # (addr, port)
         self._my_addr: Optional[str] = None
+
+    def _registration_put(self, epoch: int, addr, port, rank, my_addr,
+                          **kw) -> bool:
+        """The advertisement PUT, skipped when ``epoch`` has been
+        superseded by a newer registration (see ``_put_lock`` above).
+        Returns whether the PUT ran."""
+        with self._put_lock:
+            with self._lock:
+                if self._reg_epoch != epoch:
+                    _LOG.debug(
+                        "skipping stale registration PUT for rank %s "
+                        "(epoch %d superseded by %d)", rank, epoch,
+                        self._reg_epoch)
+                    return False
+            # lockcheck: ignore[dedicated I/O-ordering lock: serializes registration PUTs only; the manager lock is NOT held here]
+            put_data_into_kvstore(addr, port, SCOPE_WORKER_ADDRS,
+                                  str(rank), my_addr.encode(), **kw)
+            return True
 
     def init(self, rendezvous_addr: Optional[str] = None,
              rendezvous_port: Optional[int] = None,
@@ -97,10 +127,20 @@ class WorkerNotificationManager:
                 socket.gethostname()
             self._rdv = (addr, port)
             self._my_addr = f"{host}:{self._service.port}"
-            put_data_into_kvstore(addr, port, SCOPE_WORKER_ADDRS, str(rank),
-                                  self._my_addr.encode())
-            _LOG.debug("worker notification service at %s (rank %s)",
-                       self._my_addr, rank)
+            my_addr = self._my_addr
+            self._reg_epoch += 1
+            epoch = self._reg_epoch
+        # The registration PUT runs OFF the manager lock (the lockcheck
+        # blocking-under-lock fix, same bug class as the PR 4 reregister
+        # move): holding the manager lock through a network call wedged
+        # any concurrent reregister() — and with it the driver's
+        # membership push — behind a slow/hung rendezvous for the full KV
+        # timeout. The epoch check inside keeps the one ordering that
+        # matters: an init PUT delayed past a reregister is skipped, never
+        # re-advertised under a stale rank key.
+        self._registration_put(epoch, addr, port, rank, my_addr)
+        _LOG.debug("worker notification service at %s (rank %s)",
+                   my_addr, rank)
 
     def reregister(self, rank: Optional[int] = None):
         """Re-advertise this worker's address after a reset: the global rank
@@ -120,13 +160,14 @@ class WorkerNotificationManager:
                 rank = int(os.environ.get(env_mod.HOROVOD_RANK, "0"))
             addr, port = self._rdv
             my_addr = self._my_addr
+            self._reg_epoch += 1
+            epoch = self._reg_epoch
 
         def _attempt():
             failpoint("elastic.reregister")
             # retries=0: retrying() owns the schedule, one layer of backoff
-            put_data_into_kvstore(addr, port, SCOPE_WORKER_ADDRS,
-                                  str(rank), my_addr.encode(),
-                                  timeout=10, retries=0)
+            self._registration_put(epoch, addr, port, rank, my_addr,
+                                   timeout=10, retries=0)
 
         try:
             retrying(_attempt, attempts=4, base_delay=0.1, max_delay=2.0,

@@ -195,6 +195,10 @@ class _Instrument:
 
     kind = "untyped"
 
+    # every instrument is written from arbitrary hot-path threads and
+    # snapshotted by the emitter thread (tools/check.py lockcheck)
+    _GUARDED_BY = {"_values": "_lock"}
+
     def __init__(self, name: str, help: str):
         self.name = name
         self.help = help
@@ -309,6 +313,8 @@ class EventLog(_Instrument):
 
     kind = "events"
 
+    _GUARDED_BY = {"_log": "_lock", "_seq": "_lock"}
+
     def __init__(self, name: str, help: str, maxlen: int = 256):
         super().__init__(name, help)
         self._log = collections.deque(maxlen=maxlen)
@@ -357,6 +363,8 @@ _NOOP = _Noop()
 class Registry:
     """Thread-safe name -> instrument table. Use the process-wide
     :func:`registry` singleton; direct construction is for tests."""
+
+    _GUARDED_BY = {"_metrics": "_lock"}
 
     def __init__(self, enabled: bool = True):
         self.enabled = enabled

@@ -47,6 +47,21 @@ class StallInspector:
     an infinite stall into the exact exception the elastic run-loop
     restores-and-retries from (``elastic/run.py``)."""
 
+    # lock discipline (tools/check.py lockcheck; docs/static_analysis.md):
+    # every attribute below is written by the user/engine threads
+    # (record_*) and read by the watch thread — one lock covers the lot.
+    # Streak counters (_pub_fail_*, _cross_warned, _escalated) are watch-
+    # thread-private and intentionally unguarded.
+    _GUARDED_BY = {
+        "_outstanding": "_lock",
+        "_warned": "_lock",
+        "_heartbeat_step": "_lock",
+        "_heartbeat_time": "_lock",
+        "_hb_idle": "_lock",
+        "replay_fallbacks": "_lock",
+        "_replay_reasons": "_lock",
+    }
+
     def __init__(self, warning_seconds: float = 60.0, shutdown_seconds: float = 0.0,
                  check_interval: float = 5.0,
                  kv: Optional[Tuple[str, int]] = None,
@@ -366,18 +381,32 @@ class StallInspector:
             now = time.monotonic()
             with self._lock:
                 items = list(self._outstanding.items())
+                # membership must be read under the same lock that
+                # record_done() discards under — the old off-lock
+                # `name not in self._warned` raced the discard and could
+                # re-warn for a tensor that had already completed
+                # (lockcheck off-lock-access regression,
+                # tests/test_race_regressions.py)
+                warned = set(self._warned)
             self._m_stalled.set(sum(
                 1 for _, t0 in items if now - t0 > self.warning_seconds))
             for name, t0 in items:
                 age = now - t0
-                if age > self.warning_seconds and name not in self._warned:
+                if age > self.warning_seconds and name not in warned:
+                    with self._lock:
+                        if name not in self._outstanding:
+                            # completed while this sweep ran: warning it
+                            # now would be noise, and the _warned entry
+                            # would leak forever (record_done already did
+                            # its discard), suppressing a REAL stall of a
+                            # later op reusing the name
+                            continue
+                        self._warned.add(name)
                     logger.warning(
                         "One or more tensors were submitted to be reduced/gathered "
                         "but have not completed for %.0f s: %s. This may indicate a "
                         "rank that stopped contributing (stall_inspector.h:75 "
                         "analog).", age, name)
-                    with self._lock:
-                        self._warned.add(name)
                 if self.shutdown_seconds > 0 and age > self.shutdown_seconds:
                     logger.error("Stalled tensor %s exceeded shutdown threshold "
                                  "%.0f s; aborting.", name, self.shutdown_seconds)

@@ -235,6 +235,7 @@ class Timeline:
                 try:
                     ev = self._q.get(timeout=0.5)
                 except queue.Empty:
+                    # lockcheck: ignore[single-writer shutdown flag: stop() also enqueues a None sentinel, a stale read costs one 0.5s poll]
                     if not self._running:
                         break
                     continue

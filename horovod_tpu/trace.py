@@ -92,6 +92,19 @@ class TraceRecorder:
     enabled (``engine.trace is not None``), so the disabled hot path takes
     no lock at all."""
 
+    # lock discipline (tools/check.py lockcheck): the engine's dispatch
+    # threads record events while the TracePublisher thread snapshots
+    # segments — every ring/map attribute rides the one lock.
+    _GUARDED_BY = {
+        "_events": "_lock",
+        "_total": "_lock",
+        "_seq": "_lock",
+        "_live": "_lock",
+        "_beacons": "_lock",
+        "_step": "_lock",
+        "_world_version": "_lock",
+    }
+
     def __init__(self, rank: int = 0, capacity: int = DEFAULT_RING_CAPACITY):
         self.rank = rank
         self.capacity = max(int(capacity), 16)
@@ -108,6 +121,7 @@ class TraceRecorder:
 
     # -- event recording (engine hooks) ------------------------------------
 
+    # requires: _lock
     def _append(self, ev: dict):
         self._events.append(ev)
         self._total += 1
@@ -135,7 +149,13 @@ class TraceRecorder:
     def live_corr(self, name: str) -> Optional[str]:
         """The correlation id of a currently-outstanding op (what the
         timeline hook tags its span args with)."""
-        return self._live.get(name)
+        # under the lock like every other _live access: the engine's cycle
+        # thread retires handles (record_done pops) concurrently with the
+        # timeline hook reading here, and a bare dict .get during a pop is
+        # an implementation detail, not a contract (lockcheck
+        # off-lock-access regression)
+        with self._lock:
+            return self._live.get(name)
 
     def record_dispatch(self, names, activity: str, dur_s: float):
         """One dispatch-phase event per involved tensor (a grouped launch

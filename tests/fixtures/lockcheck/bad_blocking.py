@@ -1,0 +1,30 @@
+"""Lockcheck fixture: blocking calls made while holding a lock."""
+
+import threading
+import time
+
+
+class Publisher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._payload = {}
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        pass
+
+    def bad_sleep(self):
+        with self._lock:
+            time.sleep(1.0)  # VIOLATION: sleep under lock
+
+    def bad_join(self):
+        with self._lock:
+            self._thread.join(timeout=5)  # VIOLATION: thread join under lock
+
+    def bad_indirect(self, fn):
+        with self._lock:
+            fn(time.sleep)  # VIOLATION: blocking callable handed to an
+            return None     # invoker (the _translate_failure(x) idiom)
+
+    def good_sleep(self):
+        time.sleep(0.0)

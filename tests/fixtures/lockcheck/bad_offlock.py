@@ -1,0 +1,27 @@
+"""Lockcheck fixture: off-lock read and write of a guarded attribute."""
+
+import threading
+
+
+class Table:
+    _GUARDED_BY = {"_items": "_lock", "_count": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+        self._count = 0
+
+    def good_put(self, key, value):
+        with self._lock:
+            self._items[key] = value
+            self._count += 1
+
+    def bad_write(self, key, value):
+        self._items[key] = value  # VIOLATION: off-lock write
+
+    def bad_read(self):
+        return self._count  # VIOLATION: off-lock read
+
+    def good_snapshot(self):
+        with self._lock:
+            return dict(self._items), self._count

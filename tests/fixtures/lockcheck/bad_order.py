@@ -1,0 +1,25 @@
+"""Lockcheck fixture: inconsistent lock acquisition order (A->B vs B->A)
+plus a non-reentrant re-acquire."""
+
+import threading
+
+
+class TwoLocks:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def forward(self):
+        with self._a_lock:
+            with self._b_lock:  # order edge A -> B
+                pass
+
+    def backward(self):
+        with self._b_lock:
+            with self._a_lock:  # VIOLATION: order edge B -> A (cycle)
+                pass
+
+    def relock(self):
+        with self._a_lock:
+            with self._a_lock:  # VIOLATION: non-reentrant re-acquire
+                pass

@@ -1,0 +1,23 @@
+"""Lockcheck fixture: a `# requires:` helper called without the lock."""
+
+import threading
+
+
+class Helper:
+    _GUARDED_BY = {"_table": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}
+
+    # requires: _lock
+    def _evict_one(self):
+        if self._table:
+            self._table.popitem()
+
+    def good_call(self):
+        with self._lock:
+            self._evict_one()
+
+    def bad_call(self):
+        self._evict_one()  # VIOLATION: requires _lock, called without it

@@ -1,0 +1,20 @@
+"""Lockcheck fixture: a stale suppression (excusing nothing) and a
+suppression without a reason."""
+
+import threading
+
+
+class Sup:
+    _GUARDED_BY = {"_value": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def fine(self):
+        # lockcheck: ignore[old excuse for code that was since fixed]
+        with self._lock:  # STALE: the access below is properly locked now
+            return self._value
+
+    def reasonless(self):
+        return self._value  # lockcheck: ignore

@@ -1,0 +1,18 @@
+"""Lockcheck fixture: a thread target touching shared mutable state with
+no _GUARDED_BY annotation at all."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._state = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            self._state += 1  # VIOLATION: unannotated shared attribute
+
+    def read_state(self):
+        return self._state

@@ -1,0 +1,51 @@
+"""Lockcheck fixture: a fully disciplined class — zero findings.
+
+Exercises every convention: _GUARDED_BY dict, a trailing guarded_by
+comment, a requires-annotated helper, an internally-synced member, linear
+acquire/release, and a thread target touching only guarded state.
+"""
+
+import queue
+import threading
+
+
+class Clean:
+    _GUARDED_BY = {
+        "_items": "_lock",
+        "_count": "_lock",
+        "_q": "<internal>",
+    }
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+        self._count = 0
+        self._q = queue.Queue()
+        self._seen = set()  # guarded_by: _lock
+        self._stop_evt = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    # requires: _lock
+    def _bump(self):
+        self._count += 1
+
+    def put(self, key, value):
+        with self._lock:
+            self._items[key] = value
+            self._seen.add(key)
+            self._bump()
+        self._q.put(key)
+
+    def manual(self):
+        self._lock.acquire()
+        try:
+            return dict(self._items)
+        finally:
+            self._lock.release()
+
+    def _loop(self):
+        while not self._stop_evt.wait(0.1):
+            with self._lock:
+                n = self._count
+            if n:
+                self._q.put(n)

@@ -141,19 +141,10 @@ class TestActions:
 # -- namespace lint (tools/check_fault_names.py, tier-1 wiring) -------------
 
 class TestFaultNameLint:
-    def test_declared_specs_clean(self):
-        import os
-        import sys
-        sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-        from tools.check_fault_names import (scan_call_sites,
-                                             validate_call_sites,
-                                             validate_specs)
-        assert validate_specs(faults.FAULT_SPECS) == []
-        pkg_root = os.path.join(os.path.dirname(__file__), "..",
-                                "horovod_tpu")
-        sites = scan_call_sites(pkg_root)
-        assert sites, "no failpoint call sites found — scan broken?"
-        assert validate_call_sites(faults.FAULT_SPECS, sites) == []
+    # NOTE (ISSUE 7): the clean-tree wiring (declared specs + call sites
+    # lint-clean) moved to the unified parametrized suite in
+    # tests/test_check.py (tools/check.py runs every lint); only the
+    # error-path unit tests stay here next to the registry they exercise.
 
     def test_lint_catches_undeclared_call_site(self):
         import os

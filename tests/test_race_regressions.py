@@ -1,0 +1,295 @@
+"""Regression tests for the true lock-discipline violations the ISSUE 7
+lockcheck surfaced in existing code, each exercising the racy
+interleaving the fix closed:
+
+- engine ZeRO-1 prefetch registry: dispatch-path writes raced the
+  invalidation sweep's dict iteration (pre-fix: RuntimeError "dictionary
+  changed size during iteration");
+- elastic driver results table: process-monitor threads wrote
+  ``_results`` off-lock while ``get_results`` copied it (pre-fix: same
+  RuntimeError class);
+- stall inspector ``_warned``: the watch thread warned and THEN added
+  the name off the membership lock — a ``record_done`` landing between
+  the two leaked a stale ``_warned`` entry that suppressed any future
+  stall warning for that name (reproduced deterministically via a log
+  handler that retires the op from inside the warning itself);
+- trace recorder ``live_corr``: read ``_live`` off-lock while the cycle
+  thread's ``record_done`` popped it (GIL-atomic in CPython today, so
+  this one is a discipline check: the locked read must return either
+  the live corr or None under churn, never crash or tear).
+
+The first three fail against the pre-fix code; the interleaving knobs
+(``sys.setswitchinterval`` and the handler injection) make the schedules
+that used to need unlucky timing near-certain.
+"""
+
+import logging
+import sys
+import threading
+import time
+
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.stall_inspector import StallInspector
+from horovod_tpu.trace import TraceRecorder
+
+# plain tier-1 runtime tests — deliberately NOT `-m lint`: that marker is
+# the static-analysis suite, and these initialize a live engine and churn
+# real threads
+N_ROUNDS = 400
+
+
+@pytest.fixture()
+def engine():
+    hvd.init()
+    yield hvd._engine()
+
+
+@pytest.fixture()
+def fast_switches():
+    """Force thread switches every few bytecodes so a cross-thread dict
+    mutation lands inside any unguarded iteration with near-certainty."""
+    prev = sys.getswitchinterval()
+    sys.setswitchinterval(1e-6)
+    yield
+    sys.setswitchinterval(prev)
+
+
+class TestPrefetchRegistryRace:
+    def test_concurrent_note_and_invalidate(self, engine, fast_switches):
+        """Writers register fresh legs (growing the dict) while a sweeper
+        iterates it for GC and clears it for invalidation: pre-fix the
+        sweep crashed with 'dictionary changed size during iteration';
+        post-fix no exception and every leg is accounted exactly once."""
+        eng = engine
+        eng.invalidate_prefetch("test isolation")
+        inval0 = eng._m_prefetch_inval.value()
+        # several independent rounds: one racy schedule can get lucky,
+        # three back to back (under 1 microsecond switch intervals) cannot
+        for _ in range(3):
+            errors = self._one_round(eng)
+            assert not errors, errors
+        # drain: a final invalidate accounts every still-held leg
+        eng.invalidate_prefetch("final drain")
+        assert not eng._zero1_prefetch
+        noted = eng._m_prefetch.value()
+        dropped = eng._m_prefetch_inval.value() - inval0
+        assert dropped <= noted
+
+    @staticmethod
+    def _one_round(eng):
+        stop = threading.Event()
+        errors = []
+
+        def noter():
+            try:
+                i = 0
+                while not stop.is_set():
+                    # fresh keys: the registry keeps growing, so the
+                    # sweeper's iteration always races live inserts
+                    eng._note_prefetch(("bucket", i))
+                    i += 1
+            except Exception as e:  # pragma: no cover - the regression
+                errors.append(e)
+
+        def sweeper():
+            try:
+                # sweep only once the registry is busy: a sweep over a
+                # near-empty dict finishes in too few bytecodes to overlap
+                # an insert, and the pre-fix crash needs the overlap
+                deadline = time.monotonic() + 10
+                while len(eng._zero1_prefetch) < 200 and \
+                        time.monotonic() < deadline:
+                    pass
+                for j in range(N_ROUNDS * 2):
+                    if j % 10 == 9:
+                        eng.invalidate_prefetch(f"round {j}")
+                    else:
+                        eng._prefetch_gc()
+            except Exception as e:  # pragma: no cover - the regression
+                errors.append(e)
+
+        threads = [threading.Thread(target=noter) for _ in range(2)]
+        sw = threading.Thread(target=sweeper)
+        for t in threads:
+            t.start()
+        sw.start()
+        sw.join(timeout=60)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        return errors
+
+    def test_gc_drops_only_stale_world_versions(self, engine):
+        eng = engine
+        eng.invalidate_prefetch("test isolation")
+        eng._note_prefetch(("keep",))
+        with eng._lock:
+            eng._zero1_prefetch[("stale",)] = {
+                "world_version": eng.world_version - 1}
+        eng._prefetch_gc()
+        assert ("keep",) in eng._zero1_prefetch
+        assert ("stale",) not in eng._zero1_prefetch
+        eng.invalidate_prefetch("test isolation")
+
+
+class TestDriverResultsRace:
+    def _driver(self):
+        from horovod_tpu.elastic.driver import ElasticDriver
+        from horovod_tpu.elastic.discovery import HostDiscovery
+
+        class _FixedDiscovery(HostDiscovery):
+            def find_available_hosts_and_slots(self):
+                return {"localhost": 4}
+
+        class _NullRendezvous:
+            def init(self, assignments):
+                pass
+
+        return ElasticDriver(_NullRendezvous(), _FixedDiscovery(),
+                             min_np=1, max_np=8)
+
+    def test_concurrent_exits_vs_result_reads(self, fast_switches):
+        """Process monitors record exits from their own threads while the
+        run loop snapshots get_results: pre-fix the off-lock dict copy
+        raced the growing table ('dictionary changed size during
+        iteration'); post-fix every exit lands and nothing raises."""
+        driver = self._driver()
+        errors = []
+        stop = threading.Event()
+        n_threads, per_thread = 4, 250
+
+        def monitor(tid):
+            try:
+                for i in range(per_thread):
+                    driver.record_worker_exit(f"host{tid}", i, 0,
+                                              result=(tid, i))
+            except Exception as e:  # pragma: no cover - the regression
+                errors.append(e)
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    driver.get_results()
+            except Exception as e:  # pragma: no cover - the regression
+                errors.append(e)
+
+        threads = [threading.Thread(target=monitor, args=(t,))
+                   for t in range(n_threads)]
+        rd = threading.Thread(target=reader)
+        rd.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        stop.set()
+        rd.join(timeout=10)
+        assert not errors, errors
+        results = driver.get_results()
+        assert len(results) == n_threads * per_thread
+        for tid in range(n_threads):
+            for i in range(per_thread):
+                assert results[f"host{tid}:{i}"] == ((tid, i), 0)
+
+
+class TestStallWarnedRace:
+    def test_completion_during_warning_leaves_no_stale_entry(self):
+        """Deterministic reproduction of the _warned leak: a log handler
+        retires the op from INSIDE the stall warning — the exact moment a
+        cycle-thread completion used to land. Pre-fix the watch thread
+        then added the already-completed name to _warned, permanently
+        suppressing any future stall warning for it; post-fix the name is
+        added under the lock only while still outstanding, and the
+        completion's discard erases it."""
+        insp = StallInspector(warning_seconds=0.0, check_interval=0.01,
+                              kv=None)
+        fired = threading.Event()
+
+        class _CompleteOnWarn(logging.Handler):
+            def emit(self, record):
+                msg = record.getMessage()
+                if "have not completed" in msg and "race.op" in msg:
+                    insp.record_done("race.op")
+                    fired.set()
+
+        handler = _CompleteOnWarn()
+        logging.getLogger("horovod_tpu").addHandler(handler)
+        try:
+            insp.record_enqueue("race.op")
+            assert fired.wait(timeout=10), "stall warning never fired"
+            time.sleep(0.05)  # a couple more watch ticks
+            with insp._lock:
+                outstanding = dict(insp._outstanding)
+                warned = set(insp._warned)
+            assert outstanding == {}
+            assert "race.op" not in warned, (
+                "stale _warned entry leaked: a stall of a later op named "
+                "'race.op' would never be warned about")
+        finally:
+            logging.getLogger("horovod_tpu").removeHandler(handler)
+            insp.stop()
+
+
+class TestRegistrationPutOrdering:
+    def test_superseded_init_put_is_skipped(self, monkeypatch):
+        """A delayed init() PUT must never land after a reregister() and
+        re-advertise a stale rank key: each registration bumps an epoch,
+        and a PUT whose epoch was superseded skips instead of writing."""
+        from horovod_tpu.elastic import worker as worker_mod
+        mgr = worker_mod.WorkerNotificationManager()
+        puts = []
+        monkeypatch.setattr(
+            worker_mod, "put_data_into_kvstore",
+            lambda addr, port, scope, key, value, **kw:
+                puts.append((key, value)))
+        with mgr._lock:
+            mgr._reg_epoch += 1
+            stale_epoch = mgr._reg_epoch      # init captured this...
+            mgr._reg_epoch += 1               # ...then a reregister ran
+            fresh_epoch = mgr._reg_epoch
+        assert mgr._registration_put(stale_epoch, "h", 1, 3,
+                                     "old:1") is False
+        assert puts == []                     # stale write never issued
+        assert mgr._registration_put(fresh_epoch, "h", 1, 4,
+                                     "new:1") is True
+        assert puts == [("4", b"new:1")]
+
+
+class TestLiveCorrRace:
+    def test_live_corr_under_concurrent_retirement(self):
+        """The timeline hook reads live_corr while another thread (the
+        cycle loop in production) retires the same names: the locked read
+        returns the live corr or None, never a crash or a torn value."""
+        rec = TraceRecorder(rank=0, capacity=128)
+        errors = []
+        stop = threading.Event()
+
+        def churn():
+            try:
+                for i in range(N_ROUNDS * 4):
+                    rec.record_enqueue(f"t{i % 8}", "allreduce", 64, 0)
+                    rec.record_done(f"t{i % 8}")
+            except Exception as e:  # pragma: no cover - the regression
+                errors.append(e)
+            finally:
+                stop.set()
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    for i in range(8):
+                        corr = rec.live_corr(f"t{i}")
+                        assert corr is None or corr.startswith(f"t{i}#")
+            except Exception as e:  # pragma: no cover - the regression
+                errors.append(e)
+
+        t1, t2 = threading.Thread(target=churn), \
+            threading.Thread(target=reader)
+        t1.start()
+        t2.start()
+        t1.join(timeout=30)
+        t2.join(timeout=30)
+        assert not errors, errors
+        # everything retired: no live correlation ids remain
+        assert all(rec.live_corr(f"t{i}") is None for i in range(8))

@@ -6,9 +6,10 @@
    name declared in ``FAULT_SPECS`` (``test.*`` names are reserved for
    suites and must not appear in framework code).
 
-Run from the repo root: ``python tools/check_fault_names.py``. Exit code 0
-means clean. Invoked from a tier-1 test (tests/test_faults.py) so the
-namespace stays lint-clean as future PRs add failpoints.
+Thin shim: ``tools/check.py`` is the unified driver that runs this next
+to the lockcheck/knob/metric/trace-schema lints (one tier-1 test,
+tests/test_check.py). This entry point remains for single-lint runs:
+``python tools/check_fault_names.py``; exit code 0 means clean.
 """
 
 from __future__ import annotations

@@ -1,11 +1,13 @@
 """Lint the metrics namespace: every metric the framework declares must
 match ``^hvd_tpu_[a-z0-9_]+$`` and carry a non-empty help string.
 
-Run from the repo root: ``python tools/check_metric_names.py``. Exit code 0
-means clean. Invoked from a tier-1 test (tests/test_metrics.py) so the
-namespace stays lint-clean as future PRs add instruments — the registry
-factories enforce the same rules at runtime for undeclared names, but this
-check catches a bad declaration before anything ever instantiates it.
+Thin shim: ``tools/check.py`` is the unified driver that runs this next
+to the lockcheck/knob/fault/trace-schema lints (one tier-1 test,
+tests/test_check.py). This entry point remains for single-lint runs:
+``python tools/check_metric_names.py``; exit code 0 means clean. The
+registry factories enforce the same rules at runtime for undeclared
+names, but this check catches a bad declaration before anything ever
+instantiates it.
 """
 
 from __future__ import annotations

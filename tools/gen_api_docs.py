@@ -86,6 +86,10 @@ SECTIONS = [
         "render_cluster_trace", "clock_offset", "load_trace_events",
         "load_trace_file", "make_corr", "parse_corr"]),
     ("Autotuning", "horovod_tpu.autotune.parameter_manager", []),
+    ("Static analysis", "horovod_tpu.analysis", []),
+    ("", "horovod_tpu.analysis.lockcheck", []),
+    ("", "horovod_tpu.analysis.knobcheck", []),
+    ("", "horovod_tpu.common.knobs", []),
 ]
 
 
@@ -109,6 +113,48 @@ def _sig(obj) -> str:
     return re.sub(r" at 0x[0-9a-f]+", "", sig)
 
 
+def _knob_rows(specs, internal):
+    rows = []
+    for name in sorted(specs):
+        spec = specs[name]
+        if bool(spec.get("internal")) is not internal:
+            continue
+        typ = spec["type"]
+        if typ == "choice" and spec.get("choices"):
+            typ = "choice: " + "/".join(spec["choices"])
+        default = str(spec.get("default", "")) or "(unset)"
+        help_str = " ".join(spec["help"].split())
+        rows.append(f"| `{name}` | {typ} | `{default}` | {help_str} |")
+    return rows
+
+
+def knob_section():
+    """The generated "Configuration knobs" section: rendered from
+    horovod_tpu.common.knobs.KNOB_SPECS (the registry the knob lint in
+    tools/check.py keeps in sync with the code's actual env reads)."""
+    from horovod_tpu.common.knobs import KNOB_SPECS
+    out = ["## Configuration knobs",
+           "",
+           "Generated from `horovod_tpu.common.knobs.KNOB_SPECS` — the "
+           "central registry of every environment variable the framework "
+           "reads. `python tools/check.py --only knobs` fails on knobs "
+           "read but not declared here, and on declared knobs nothing "
+           "reads (see docs/static_analysis.md).",
+           "",
+           "| knob | type | default | description |",
+           "| --- | --- | --- | --- |"]
+    out += _knob_rows(KNOB_SPECS, internal=False)
+    out += ["",
+            "Launcher/rendezvous plumbing (set by `tpurun` and the "
+            "elastic driver; users rarely set these directly):",
+            "",
+            "| variable | type | default | description |",
+            "| --- | --- | --- | --- |"]
+    out += _knob_rows(KNOB_SPECS, internal=True)
+    out.append("")
+    return out
+
+
 def main():
     out = ["# API reference",
            "",
@@ -117,6 +163,7 @@ def main():
            "(drop-in for the reference's `import horovod.torch as hvd` "
            "call sites — see docs/migrate.md for the mapping).",
            ""]
+    out.extend(knob_section())
     for title, modname, names in SECTIONS:
         mod = importlib.import_module(modname)
         if title:
