@@ -512,6 +512,77 @@ def bench_sharded_memory():
     return out
 
 
+def bench_checkpoint():
+    """ISSUE 9 acceptance metrics for the async sharded checkpoint tier:
+
+    - ``ckpt_snapshot_stall_ms_per_step``: step-path cost of requesting
+      one async snapshot (~0 by construction — the request only stamps
+      references; device_get/serialize/write ride the background
+      thread). Measured as the mean over a committing loop.
+    - ``ckpt_sync_write_ms``: the full synchronous write cost for scale
+      (what the stall WOULD be without the async tier).
+    - ``time_to_recover_s``: wall time for a fresh world to restore the
+      last durable generation with one writer rank's disk deleted —
+      discovery + peer-redundant sourcing + checksum + decode.
+    """
+    import shutil
+    import tempfile
+    import time as _t
+
+    import numpy as np
+
+    from horovod_tpu.checkpoint import CheckpointManager
+
+    # ~32 MB of state: big enough that a synchronous write is visible,
+    # small enough for CI
+    rng = np.random.RandomState(0)
+    tree = {"params": [rng.rand(1024, 1024).astype(np.float32)
+                       for _ in range(8)]}
+    steps = 10
+    out = {}
+    with tempfile.TemporaryDirectory() as d:
+        mgrs = [CheckpointManager(d, rank=r, world_size=2, redundancy=1)
+                for r in range(2)]
+        try:
+            stalls = []
+            for s in range(1, steps + 1):
+                t0 = _t.perf_counter()
+                for m in mgrs:
+                    m.snapshot(tree, step=s)
+                stalls.append(_t.perf_counter() - t0)
+            for m in mgrs:
+                m.wait_idle(120)
+            out["ckpt_snapshot_stall_ms_per_step"] = round(
+                sum(stalls) / len(stalls) * 1e3, 3)
+            # synchronous contrast: request + drain = the full write cost
+            # (both ranks request first — a lone rank's replica fetch
+            # would otherwise poll for a peer generation not yet begun)
+            t0 = _t.perf_counter()
+            for m in mgrs:
+                m.snapshot(tree, step=steps + 1)
+            for m in mgrs:
+                m.wait_idle(120)
+            out["ckpt_sync_write_ms"] = round((_t.perf_counter() - t0)
+                                              * 1e3, 1)
+            out["ckpt_shard_mb_per_rank"] = round(
+                sum(a.nbytes for a in tree["params"]) / 2 / 2**20, 1)
+        finally:
+            for m in mgrs:
+                m.close(flush=False)
+        # recovery: rank 1's host is gone; a fresh np=2 world restores
+        # from rank 0's peer replica
+        shutil.rmtree(os.path.join(d, "rank1"), ignore_errors=True)
+        t0 = _t.perf_counter()
+        fresh = CheckpointManager(d, rank=0, world_size=2, redundancy=1)
+        try:
+            res = fresh.restore_latest(template=tree)
+            out["time_to_recover_s"] = round(_t.perf_counter() - t0, 3)
+            out["ckpt_recovered_step"] = res.step
+        finally:
+            fresh.close(flush=False)
+    return out
+
+
 def bench_pipeline_bubble():
     """Measured 1F1B pipeline bubble on a 4-stage CPU-mesh pipeline
     (VERDICT r5 gap: the overlap story was schedule math): measured step
@@ -1005,6 +1076,13 @@ def main():
     except Exception as e:
         bubble = {"error": f"{type(e).__name__}: {e}"}
 
+    # async sharded checkpoint tier (ISSUE 9): snapshot stall per step
+    # (~0 for the async path) + time-to-recover from peer shards
+    try:
+        ckpt = bench_checkpoint()
+    except Exception as e:
+        ckpt = {"ckpt_error": f"{type(e).__name__}: {e}"}
+
     # ---- report -----------------------------------------------------------
     spmd_img_s = batch / spmd_dt
     raw_img_s = batch / raw_dt
@@ -1069,6 +1147,7 @@ def main():
         "optimizer_state_bytes_per_chip": opt_state_bytes,
         "pipeline_bubble_pct": bubble.get("pipeline_bubble_pct"),
         "pipeline_bubble_detail": bubble,
+        **ckpt,
         "spmd_spread_pct": round(spmd_spread, 1),
         "achieved_tflops_per_chip": round(tflops_chip, 2),
         "mfu_pct": (round(100.0 * tflops_chip / peak, 2)

@@ -1,0 +1,873 @@
+"""The checkpoint manager: async snapshots, peer-redundant placement,
+commit-barrier generations, and elastic-world-resize restore.
+
+Write path (per generation ``g`` = the snapshot's step):
+
+1. ``snapshot(tree, step)`` on the step path only *stamps* the request —
+   jax arrays are immutable, so holding references costs nothing; the
+   device→host copy (``jax.device_get``), serialization, file writes,
+   and KV publishes all run on the manager's background thread.
+   Double-buffered: one write in flight plus one pending slot that a
+   newer request replaces (counted as skipped) — step N+1 never blocks
+   on step N's write.
+2. The worker encodes the flat stream, writes this rank's shard + the
+   shared header under ``<dir>/rank<r>/gen<g>/``, publishes the shard
+   bytes to the rendezvous KV (scope ``ckptshard``, chunked), fetches
+   its ``redundancy`` successor ranks' shards from the KV and stores
+   them as local replicas, then writes/publishes its manifest LAST —
+   manifest presence is the rank-local commit mark.
+3. Old generations (and their KV chunks) are garbage-collected, keeping
+   the newest ``keep``; a generation that never completed is deleted as
+   soon as a newer one lands.
+
+Restore path: find the newest generation whose manifests pass the
+commit barrier (KV manifests first, disk scan fallback), re-publish
+every locally-held shard to the KV (so a peer whose disk died can fetch
+this rank's replica — the KV-mediated peer transfer), source each
+needed shard own-disk → peer-disk (shared fs) → KV, verify checksums,
+and re-slice the flat stream against the *current* world's
+``shard_spec`` padding — a checkpoint written at np=N restores at any
+np=M.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import threading
+import time
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..faults import DROP, failpoint
+from ..metrics import registry as metrics_registry
+from . import manifest as mf
+from . import shard_io
+
+logger = logging.getLogger("horovod_tpu.checkpoint")
+
+CKPT_KV_SCOPE = "ckpt"            # manifests: ckpt/<rank>
+CKPT_SHARD_KV_SCOPE = "ckptshard"  # shard bytes: ckptshard/g<g>.r<q>
+
+_GEN_PREFIX = "gen"
+
+
+class CheckpointRestoreError(RuntimeError):
+    """No durable generation could be restored (missing shards on every
+    source, checksum corruption, or an incomplete commit barrier)."""
+
+
+class RestoreResult(NamedTuple):
+    """One restored generation. ``tree`` is the template pytree with the
+    restored leaves (or the raw leaf list when no template was given);
+    ``extras`` the header's pickled side blob (plain object attrs)."""
+    tree: Any
+    extras: Optional[dict]
+    step: int
+    world_version: int
+    mode: str
+
+
+class _SnapReq(NamedTuple):
+    leaves: list            # device or host arrays, tree order
+    treedef: Any
+    step: int
+    extras: Optional[dict]
+    zero1: Optional[tuple]  # (layout, n_shards) when ZeRO-1 rank-local
+
+
+def _is_gen_dir(name: str) -> bool:
+    return name.startswith(_GEN_PREFIX) and \
+        name[len(_GEN_PREFIX):].isdigit()
+
+
+def _gen_step(name: str) -> int:
+    return int(name[len(_GEN_PREFIX):])
+
+
+class CheckpointManager:
+    """Per-rank async sharded checkpointing (see module docstring).
+
+    ``kv`` is the rendezvous KV server ``(addr, port)`` or None (disk
+    only — replicas then come from peer rank directories on a shared
+    filesystem). ``trace`` is an optional ``TraceRecorder``: snapshot
+    writes and restores record correlated spans so the flight recorder /
+    merged cluster trace shows the checkpoint timeline.
+    """
+
+    # lock discipline (tools/check.py lockcheck): the step path stamps
+    # requests while the worker thread drains them; the tiny state
+    # machine rides one condition variable (its lock). All I/O
+    # (device_get, files, KV) is off-lock on the worker thread.
+    _GUARDED_BY = {
+        "_pending": "_cond",
+        "_writing": "_cond",
+        "_stopped": "_cond",
+        "_last_written_step": "_cond",
+    }
+
+    def __init__(self, directory: str, rank: int = 0, world_size: int = 1,
+                 *, world_version: int = 0, kv: Optional[Tuple[str, int]] = None,
+                 redundancy: int = 1, keep: int = 2,
+                 kv_chunk_bytes: Optional[int] = None,
+                 kv_timeout: float = 30.0, trace=None):
+        self.directory = str(directory)
+        self.rank = int(rank)
+        self.world_size = max(int(world_size), 1)
+        self.world_version = int(world_version)
+        self.kv = kv
+        self.redundancy = max(0, min(int(redundancy), self.world_size - 1))
+        self.keep = max(int(keep), 1)
+        self.kv_timeout = float(kv_timeout)
+        self.trace = trace
+        if kv_chunk_bytes is None:
+            from ..runner.http_client import DEFAULT_KV_CHUNK_BYTES
+            kv_chunk_bytes = DEFAULT_KV_CHUNK_BYTES
+        self.kv_chunk_bytes = int(kv_chunk_bytes)
+        self._provider: Optional[Callable[[], tuple]] = None
+        self.interval_steps = 0
+        os.makedirs(self.rank_dir(self.rank), exist_ok=True)
+        reg = metrics_registry()
+        self._m_snapshots = reg.counter("hvd_tpu_ckpt_snapshots_total")
+        self._m_bytes = reg.counter("hvd_tpu_ckpt_bytes_total")
+        self._m_restore = reg.histogram("hvd_tpu_ckpt_restore_seconds")
+        self._m_gc = reg.counter("hvd_tpu_ckpt_gc_total")
+        self._m_stall = reg.histogram("hvd_tpu_ckpt_snapshot_stall_seconds")
+        self._m_last_step = reg.gauge("hvd_tpu_ckpt_last_step")
+        self._cond = threading.Condition()
+        self._pending: Optional[_SnapReq] = None
+        self._writing = False
+        self._stopped = False
+        self._last_written_step = -1
+        self._thread = threading.Thread(target=self._run,
+                                        name="hvd-ckpt", daemon=True)
+        self._thread.start()
+
+    # -- paths ---------------------------------------------------------------
+
+    def rank_dir(self, rank: int) -> str:
+        """One rank's "disk". Tests model a lost host by deleting it."""
+        return os.path.join(self.directory, f"rank{rank}")
+
+    def gen_dir(self, step: int, rank: Optional[int] = None) -> str:
+        return os.path.join(self.rank_dir(self.rank if rank is None
+                                          else rank),
+                            f"{_GEN_PREFIX}{int(step)}")
+
+    @staticmethod
+    def shard_file(gen_dir: str, shard_rank: int) -> str:
+        return os.path.join(gen_dir, f"shard_{shard_rank}.bin")
+
+    @staticmethod
+    def _shard_kv_key(step: int, shard_rank: int) -> str:
+        return f"g{int(step)}.r{int(shard_rank)}"
+
+    # -- snapshot (step path) ------------------------------------------------
+
+    def snapshot(self, tree, step: int, extras: Optional[dict] = None
+                 ) -> bool:
+        """Request an async snapshot of a **replicated** state pytree at
+        ``step``. Returns False if a pending (not yet started) request
+        was replaced — the caller's cadence outran the writer and the
+        older request is dropped (counted as skipped), never blocked
+        on."""
+        import jax
+        t0 = time.perf_counter()
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        accepted = self._enqueue(_SnapReq(leaves, treedef, int(step),
+                                          extras, None))
+        self._m_stall.observe(time.perf_counter() - t0)
+        return accepted
+
+    def snapshot_zero1(self, shards, state_tree, layout, step: int,
+                       extras: Optional[dict] = None) -> bool:
+        """Request an async snapshot of this rank's **ZeRO-1 rank-local**
+        state: per-bucket flat parameter shards + the inner optimizer
+        state, with the optimizer's frozen bucket ``layout``
+        (``[(idxs, sizes, total, shard)]``). The payload is already
+        1/world_size of the job's state; restore at a different world
+        size re-slices it (``shard_io.zero1_reshard``)."""
+        import jax
+        t0 = time.perf_counter()
+        state_leaves, state_treedef = jax.tree_util.tree_flatten(state_tree)
+        layout = tuple((tuple(i), tuple(s), int(t), int(sh))
+                       for i, s, t, sh in layout)
+        req = _SnapReq(list(shards) + list(state_leaves), state_treedef,
+                       int(step), extras, (layout, len(shards)))
+        accepted = self._enqueue(req)
+        self._m_stall.observe(time.perf_counter() - t0)
+        return accepted
+
+    def _enqueue(self, req: _SnapReq) -> bool:
+        with self._cond:
+            if self._stopped:
+                return False
+            replaced = self._pending is not None
+            self._pending = req
+            self._cond.notify_all()
+        if replaced:
+            self._m_snapshots.inc(outcome="skipped")
+        return not replaced
+
+    def register_provider(self, fn: Callable[[], tuple]):
+        """``fn() -> (tree, step)`` (optionally ``(tree, step, extras)``)
+        for interval-driven snapshots via the engine's step hook."""
+        self._provider = fn
+
+    def on_step(self, step_index: int):
+        """Engine ``on_step_complete`` hook: snapshot the registered
+        provider every ``interval_steps`` completed steps."""
+        if self._provider is None or self.interval_steps <= 0:
+            return
+        if step_index % self.interval_steps != 0:
+            return
+        try:
+            got = self._provider()
+        except Exception as e:
+            logger.warning("checkpoint provider failed: %s", e)
+            return
+        tree, step = got[0], got[1]
+        extras = got[2] if len(got) > 2 else None
+        self.snapshot(tree, step, extras=extras)
+
+    def wait_idle(self, timeout: float = 60.0) -> bool:
+        """Block until no snapshot is pending or in flight (tests, final
+        flush). Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._pending is not None or self._writing:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+        return True
+
+    def close(self, flush: bool = True, timeout: float = 60.0):
+        if flush:
+            self.wait_idle(timeout)
+        with self._cond:
+            self._stopped = True
+            self._pending = None
+            self._cond.notify_all()
+        self._thread.join(timeout=5)
+
+    @property
+    def last_written_step(self) -> int:
+        with self._cond:
+            return self._last_written_step
+
+    # -- worker thread -------------------------------------------------------
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while self._pending is None and not self._stopped:
+                    self._cond.wait(0.5)
+                if self._stopped:
+                    return
+                req = self._pending
+                self._pending = None
+                self._writing = True
+            try:
+                self._write_generation(req)
+                self._m_snapshots.inc(outcome="written")
+                with self._cond:
+                    self._last_written_step = req.step
+            except Exception as e:
+                self._m_snapshots.inc(outcome="failed")
+                logger.warning("checkpoint write for step %d failed: %s",
+                               req.step, e)
+            finally:
+                with self._cond:
+                    self._writing = False
+                    self._cond.notify_all()
+
+    def _device_get(self, leaves) -> List[np.ndarray]:
+        import jax
+        return [np.asarray(x) for x in jax.device_get(list(leaves))]
+
+    def _write_generation(self, req: _SnapReq):
+        """The full off-step-path write: device→host copy, serialize,
+        shard, replicate, manifest. Runs on the worker thread only."""
+        if failpoint("checkpoint.write") is DROP:
+            # a dropped write models a lost snapshot: no files, no
+            # manifest — the generation simply never commits
+            raise RuntimeError("checkpoint.write failpoint dropped the "
+                               "snapshot")
+        corr_name = f"ckpt.write.g{req.step}"
+        host = self._device_get(req.leaves)
+        if req.zero1 is not None:
+            layout, n_shards = req.zero1
+            header = shard_io.zero1_header(
+                layout, host[:n_shards], host[n_shards:], step=req.step,
+                world_version=self.world_version,
+                world_size=self.world_size, extras=req.extras)
+            own_shard = shard_io.zero1_payload(host[:n_shards],
+                                               host[n_shards:])
+        else:
+            header = shard_io.make_header(
+                host, step=req.step, world_version=self.world_version,
+                world_size=self.world_size, extras=req.extras)
+            stream = shard_io.encode_leaves(host)
+            own_shard = shard_io.shard_of(stream, self.rank,
+                                          self.world_size)
+        if self.trace is not None:
+            self.trace.record_enqueue(corr_name, "checkpoint",
+                                      len(own_shard), self.world_version)
+        try:
+            self._write_files(req.step, header, own_shard)
+        finally:
+            if self.trace is not None:
+                self.trace.record_done(corr_name)
+        self._gc()
+
+    @staticmethod
+    def _write_atomic(path: str, data: bytes):
+        """Temp-file + rename: peers poll this generation directory over
+        the shared filesystem the moment a file appears, so a plain
+        open+write would let them capture (and checksum into their
+        manifests) a torn partial shard — which the cross-rank checksum
+        agreement would then reject, making a fully-successful
+        generation unrestorable. rename() makes appearance atomic."""
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.rename(tmp, path)
+
+    def _write_files(self, step: int, header: dict, own_shard: bytes):
+        gdir = self.gen_dir(step)
+        os.makedirs(gdir, exist_ok=True)
+        self._write_atomic(os.path.join(gdir, "header.json"),
+                           json.dumps(header).encode())
+        self._write_atomic(self.shard_file(gdir, self.rank), own_shard)
+        self._m_bytes.inc(len(own_shard), kind="shard")
+        checksums = {self.rank: mf.checksum(own_shard)}
+        sizes = {self.rank: len(own_shard)}
+        holds = [self.rank]
+        # publish the shard bytes so successors can take replicas (and a
+        # later restore can fetch over the wire); then hold predecessors'
+        # peers per the redundancy degree
+        if self.kv is not None and self.world_size > 1 and \
+                self.redundancy > 0:
+            from ..runner.http_client import put_large_value
+            try:
+                put_large_value(self.kv[0], self.kv[1],
+                                CKPT_SHARD_KV_SCOPE,
+                                self._shard_kv_key(step, self.rank),
+                                own_shard, chunk_bytes=self.kv_chunk_bytes,
+                                timeout=self.kv_timeout)
+            except Exception as e:
+                logger.warning("checkpoint shard KV publish failed "
+                               "(replicas degraded): %s", e)
+        for d in range(1, self.redundancy + 1):
+            peer = (self.rank + d) % self.world_size
+            if peer == self.rank:
+                break
+            data = self._await_shard_bytes(step, peer,
+                                           timeout=self.kv_timeout)
+            if data is None:
+                logger.warning(
+                    "checkpoint generation %d: could not replicate peer "
+                    "rank %d's shard (redundancy degraded)", step, peer)
+                continue
+            self._write_atomic(self.shard_file(gdir, peer), data)
+            self._m_bytes.inc(len(data), kind="replica")
+            checksums[peer] = mf.checksum(data)
+            sizes[peer] = len(data)
+            holds.append(peer)
+        man = mf.build_manifest(
+            self.rank, step=step, world_version=self.world_version,
+            world_size=self.world_size,
+            layout_digest=header["layout_digest"],
+            shard_checksums=checksums, shard_bytes=sizes, holds=holds)
+        blob = json.dumps(man).encode()
+        # the manifest is written LAST: its presence is the rank-local
+        # commit mark the barrier aggregates
+        self._write_atomic(os.path.join(gdir, f"manifest_{self.rank}.json"),
+                           blob)
+        self._m_bytes.inc(len(blob), kind="manifest")
+        self._m_last_step.set(float(step))
+        if self.kv is not None:
+            from ..runner.http_client import put_data_into_kvstore
+            try:
+                # shared header rides the KV next to the manifest (every
+                # rank publishes the identical bytes) so a restorer with
+                # neither a local nor a shared-fs copy still decodes
+                put_data_into_kvstore(self.kv[0], self.kv[1], CKPT_KV_SCOPE,
+                                      f"header.g{step}",
+                                      json.dumps(header).encode(),
+                                      timeout=self.kv_timeout)
+                put_data_into_kvstore(self.kv[0], self.kv[1], CKPT_KV_SCOPE,
+                                      str(self.rank), blob,
+                                      timeout=self.kv_timeout)
+            except Exception as e:
+                logger.warning("checkpoint manifest KV publish failed: %s",
+                               e)
+
+    def _rank_dirs(self) -> List[str]:
+        """Every rank directory physically under the checkpoint root —
+        NOT bounded by the current world size: after an N→M resize the
+        writer world's directories outnumber (or undercount) the
+        restorers'."""
+        try:
+            return sorted(n for n in os.listdir(self.directory)
+                          if n.startswith("rank") and
+                          os.path.isdir(os.path.join(self.directory, n)))
+        except OSError:
+            return []
+
+    def _fetch_shard_bytes(self, step: int, shard_rank: int,
+                           timeout: Optional[float] = None
+                           ) -> Optional[bytes]:
+        """Source one shard's bytes: this rank's own files → any rank
+        directory on the shared filesystem (owner or replica holder) →
+        the KV (chunked). Returns None when no source has it."""
+        own = os.path.basename(self.rank_dir(self.rank))
+        for name in dict.fromkeys([own] + self._rank_dirs()):
+            p = self.shard_file(
+                os.path.join(self.directory, name,
+                             f"{_GEN_PREFIX}{int(step)}"), shard_rank)
+            if os.path.exists(p):
+                try:
+                    with open(p, "rb") as f:
+                        return f.read()
+                except OSError:
+                    continue
+        if self.kv is not None:
+            from ..runner.http_client import read_large_value
+            try:
+                return read_large_value(
+                    self.kv[0], self.kv[1], CKPT_SHARD_KV_SCOPE,
+                    self._shard_kv_key(step, shard_rank),
+                    timeout=self.kv_timeout if timeout is None else timeout)
+            except Exception as e:
+                logger.debug("KV shard fetch g%d.r%d failed: %s", step,
+                             shard_rank, e)
+        return None
+
+    def _peer_moved_past(self, step: int, peer: int) -> bool:
+        """Whether ``peer`` has already committed a generation NEWER
+        than ``step`` — then it skipped ``step`` (its double-buffer
+        replaced the request) and this shard will never exist; waiting
+        out the full timeout would stall the writer 30s per divergent
+        generation. Disk manifests are authoritative on a shared fs; a
+        cheap bounded KV manifest read covers the wire-only case."""
+        gdir = self.rank_dir(peer)
+        try:
+            for g in os.listdir(gdir):
+                if _is_gen_dir(g) and _gen_step(g) > step and \
+                        os.path.exists(os.path.join(
+                            gdir, g, f"manifest_{peer}.json")):
+                    return True
+        except OSError:
+            pass
+        if self.kv is not None:
+            from ..runner.http_client import read_data_from_kvstore
+            try:
+                m = json.loads(read_data_from_kvstore(
+                    self.kv[0], self.kv[1], CKPT_KV_SCOPE, str(peer),
+                    timeout=0.3, poll_interval=0.25))
+                return int(m.get("step", -1)) > step
+            except Exception:
+                pass
+        return False
+
+    def _await_shard_bytes(self, step: int, shard_rank: int,
+                           timeout: float) -> Optional[bytes]:
+        """Poll :meth:`_fetch_shard_bytes` inside a deadline — the
+        replica-taking side of the write path races the peer's own write
+        (each rank snapshots asynchronously). Gives up early when the
+        peer is observed past this generation (it skipped it)."""
+        deadline = time.monotonic() + timeout
+        last_peer_check = 0.0
+        while True:
+            # the KV leg long-polls internally; bound each pass so the
+            # shared-fs legs re-poll too
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            data = self._fetch_shard_bytes(step, shard_rank,
+                                           timeout=min(remaining, 2.0))
+            if data is not None:
+                return data
+            now = time.monotonic()
+            if now - last_peer_check >= 1.0:
+                last_peer_check = now
+                if self._peer_moved_past(step, shard_rank):
+                    logger.debug("peer %d skipped generation %d; not "
+                                 "waiting for its shard", shard_rank,
+                                 step)
+                    return None
+            time.sleep(0.05)
+
+    # -- generation discovery / commit barrier -------------------------------
+
+    def _disk_manifests(self, step: int) -> Dict[int, dict]:
+        """Every rank's manifest for one generation, scanned across the
+        rank directories under the checkpoint root."""
+        out: Dict[int, dict] = {}
+        root = self.directory
+        try:
+            rank_names = os.listdir(root)
+        except OSError:
+            return out
+        for name in rank_names:
+            if not name.startswith("rank"):
+                continue
+            gdir = os.path.join(root, name, f"{_GEN_PREFIX}{step}")
+            if not os.path.isdir(gdir):
+                continue
+            for fn in os.listdir(gdir):
+                if not (fn.startswith("manifest_") and
+                        fn.endswith(".json")):
+                    continue
+                try:
+                    with open(os.path.join(gdir, fn)) as f:
+                        m = json.load(f)
+                    out.setdefault(int(m["rank"]), m)
+                except Exception:
+                    continue
+        return out
+
+    def _kv_manifests(self) -> Dict[int, dict]:
+        """The latest manifest each rank published to ``ckpt/<rank>``
+        (last-writer-wins; only describes the newest generation). Each
+        absent key costs one short bounded probe, NOT the long-poll —
+        discovery runs on restore/startup paths where an empty store is
+        normal, and an O(world_size · long_poll) stall there would
+        dwarf the restore itself."""
+        if self.kv is None:
+            return {}
+        from ..runner.http_client import read_data_from_kvstore
+
+        def _probe(r: int) -> Optional[dict]:
+            try:
+                raw = read_data_from_kvstore(self.kv[0], self.kv[1],
+                                             CKPT_KV_SCOPE, str(r),
+                                             timeout=0.3,
+                                             poll_interval=0.25)
+                return json.loads(raw)
+            except Exception:
+                return None
+
+        out: Dict[int, dict] = {}
+        # probe the current world's ranks, then WIDEN to the writer
+        # world any hit advertises: after an N->M downsize the old
+        # ranks >= M published manifests this restorer still needs for
+        # shard coverage when no shared filesystem is present
+        probed = 0
+        target = self.world_size
+        while probed < target:
+            m = _probe(probed)
+            if m is not None:
+                out[int(m["rank"])] = m
+                target = max(target, int(m.get("world_size", 0)))
+            probed += 1
+        return out
+
+    def _candidate_steps(self) -> List[int]:
+        """Generation steps visible anywhere under the root, newest
+        first."""
+        steps = set()
+        try:
+            rank_names = os.listdir(self.directory)
+        except OSError:
+            rank_names = []
+        for name in rank_names:
+            if not name.startswith("rank"):
+                continue
+            try:
+                gens = os.listdir(os.path.join(self.directory, name))
+            except OSError:
+                continue
+            for g in gens:
+                if _is_gen_dir(g):
+                    steps.add(_gen_step(g))
+        return sorted(steps, reverse=True)
+
+    def latest_generation(self) -> Optional[Tuple[int, Dict[int, dict]]]:
+        """The newest restorable generation: ``(step,
+        manifests_by_rank)`` or None. Both barriers are the relaxed
+        :func:`manifest.generation_restorable` form — a lost host's
+        manifest may be gone from the KV (server restart) and the disk,
+        but the survivors' holdings can still cover every shard. The KV
+        candidate (which sees ranks whose disks are reachable only over
+        the wire) and the disk scan (which covers a fresh KV server
+        after a full-cluster preemption) are BOTH consulted and the
+        newer step wins: a generation whose manifest KV publish failed
+        on every rank (a correlated KV outage is one warning-logged
+        write away) must not hide a newer complete generation that IS
+        on disk."""
+        best: Optional[Tuple[int, Dict[int, dict]]] = None
+        kv_mans = self._kv_manifests()
+        if kv_mans:
+            ok, _ = mf.generation_restorable(kv_mans)
+            if ok:
+                best = (kv_mans[min(kv_mans)]["step"], kv_mans)
+        for step in self._candidate_steps():   # newest first
+            if best is not None and step <= best[0]:
+                break
+            mans = self._disk_manifests(step)
+            ok, errs = mf.generation_restorable(mans)
+            if ok:
+                best = (step, mans)
+                break
+            logger.debug("generation %d not restorable: %s", step,
+                         errs[:3])
+        return best
+
+    # -- restore -------------------------------------------------------------
+
+    def _load_header(self, step: int, world_size: int) -> dict:
+        """Load the shared header for one generation, cross-checked
+        against the manifests' identity: a header whose (step,
+        world_size) disagrees is from a mixed/stale directory and is
+        skipped rather than trusted."""
+        own = os.path.basename(self.rank_dir(self.rank))
+        for name in dict.fromkeys([own] + self._rank_dirs()):
+            path = os.path.join(self.directory, name,
+                                f"{_GEN_PREFIX}{int(step)}", "header.json")
+            if os.path.exists(path):
+                try:
+                    with open(path) as f:
+                        header = json.load(f)
+                    if int(header["step"]) == int(step) and \
+                            int(header["world_size"]) == int(world_size):
+                        return header
+                    logger.debug("header %s disagrees with manifests "
+                                 "(step %s vs %s, world %s vs %s); "
+                                 "skipped", path, header.get("step"),
+                                 step, header.get("world_size"),
+                                 world_size)
+                except Exception:
+                    continue
+        if self.kv is not None:
+            from ..runner.http_client import read_data_from_kvstore
+            try:
+                header = json.loads(read_data_from_kvstore(
+                    self.kv[0], self.kv[1], CKPT_KV_SCOPE,
+                    f"header.g{step}", timeout=2.0, poll_interval=0.05))
+                if int(header["step"]) == int(step) and \
+                        int(header["world_size"]) == int(world_size):
+                    return header
+            except Exception:
+                pass
+        raise CheckpointRestoreError(
+            f"no readable header for generation {step} under "
+            f"{self.directory}")
+
+    def _republish_held(self, step: int, manifests: Dict[int, dict]):
+        """The peer side of the KV-mediated fetch: before sourcing its
+        own needs, every restoring rank re-publishes the shards it
+        physically holds (own + replicas) so a rank whose disk is gone
+        finds its shard on the wire."""
+        if self.kv is None:
+            return
+        from ..runner.http_client import (put_data_into_kvstore,
+                                          put_large_value)
+        gdir = self.gen_dir(step)
+        if not os.path.isdir(gdir):
+            return
+        for fn in os.listdir(gdir):
+            path = os.path.join(gdir, fn)
+            try:
+                if fn.startswith("shard_") and fn.endswith(".bin"):
+                    q = int(fn[len("shard_"):-len(".bin")])
+                    with open(path, "rb") as f:
+                        put_large_value(self.kv[0], self.kv[1],
+                                        CKPT_SHARD_KV_SCOPE,
+                                        self._shard_kv_key(step, q),
+                                        f.read(),
+                                        chunk_bytes=self.kv_chunk_bytes,
+                                        timeout=self.kv_timeout)
+                elif fn == "header.json":
+                    with open(path, "rb") as f:
+                        put_data_into_kvstore(
+                            self.kv[0], self.kv[1], CKPT_KV_SCOPE,
+                            f"header.g{step}", f.read(),
+                            timeout=self.kv_timeout)
+                elif fn.startswith("manifest_") and fn.endswith(".json"):
+                    r = fn[len("manifest_"):-len(".json")]
+                    with open(path, "rb") as f:
+                        put_data_into_kvstore(
+                            self.kv[0], self.kv[1], CKPT_KV_SCOPE, r,
+                            f.read(), timeout=self.kv_timeout)
+            except Exception as e:
+                logger.debug("republish of %s failed: %s", fn, e)
+
+    def _gather_shards(self, step: int, header: dict,
+                       manifests: Dict[int, dict],
+                       needed: List[int]) -> Dict[int, bytes]:
+        """Fetch + checksum-verify the needed writer shards."""
+        expect = {}
+        for m in manifests.values():
+            for q, c in m["shard_checksums"].items():
+                expect[int(q)] = c
+        out: Dict[int, bytes] = {}
+        for q in needed:
+            data = self._fetch_shard_bytes(step, q)
+            if data is None:
+                raise CheckpointRestoreError(
+                    f"generation {step}: shard {q} unavailable on disk, "
+                    f"peers, and KV (redundancy "
+                    f"{self.redundancy} exceeded)")
+            if q in expect and mf.checksum(data) != expect[q]:
+                raise CheckpointRestoreError(
+                    f"generation {step}: shard {q} checksum mismatch "
+                    f"(corrupt replica or torn KV write)")
+            out[q] = data
+            self._m_bytes.inc(len(data), kind="restore")
+        return out
+
+    def restore_latest(self, template=None) -> RestoreResult:
+        """Restore the newest complete generation. For a replicated
+        generation the full flat stream is reassembled from the writer
+        world's shards (whatever its size was) and decoded into
+        ``template``'s structure when given (shapes/dtypes validated),
+        else returned as a leaf list."""
+        failpoint("checkpoint.restore")
+        t0 = time.perf_counter()
+        found = self.latest_generation()
+        if found is None:
+            raise CheckpointRestoreError(
+                f"no complete checkpoint generation under "
+                f"{self.directory}")
+        step, manifests = found
+        header = self._load_header(
+            step, manifests[min(manifests)]["world_size"])
+        if header["layout_digest"] != \
+                manifests[min(manifests)]["layout_digest"]:
+            raise CheckpointRestoreError(
+                f"generation {step}: header layout digest does not match "
+                f"the manifests (mixed generations on disk)")
+        corr_name = f"ckpt.restore.g{step}"
+        if self.trace is not None:
+            self.trace.record_enqueue(corr_name, "checkpoint",
+                                      header.get("total_bytes", 0),
+                                      self.world_version)
+        try:
+            self._republish_held(step, manifests)
+            old_n = int(header["world_size"])
+            if header["mode"] == "zero1":
+                payloads = self._gather_shards(step, header, manifests,
+                                               list(range(old_n)))
+                # tree = the reshard dict: this (new-world) rank's bucket
+                # shards + resliced state leaves, plus the unpadded full
+                # flat params per bucket (template does not apply — the
+                # caller rebuilds its ShardedEagerState from these)
+                re = shard_io.zero1_reshard(header, payloads, self.rank,
+                                            self.world_size)
+                re["header"] = header
+                result = RestoreResult(re, shard_io.header_extras(header),
+                                       step, header["world_version"],
+                                       "zero1")
+            else:
+                payloads = self._gather_shards(step, header, manifests,
+                                               list(range(old_n)))
+                stream = b"".join(payloads[q] for q in range(old_n))
+                leaves = shard_io.decode_leaves(stream, header)
+                if template is not None:
+                    import jax
+                    t_leaves, treedef = jax.tree_util.tree_flatten(template)
+                    if len(t_leaves) != len(leaves):
+                        raise CheckpointRestoreError(
+                            f"template has {len(t_leaves)} leaves, "
+                            f"checkpoint has {len(leaves)}")
+                    for i, (tl, l) in enumerate(zip(t_leaves, leaves)):
+                        if tuple(np.shape(tl)) != tuple(l.shape):
+                            raise CheckpointRestoreError(
+                                f"leaf {i}: template shape "
+                                f"{tuple(np.shape(tl))} != checkpoint "
+                                f"{tuple(l.shape)}")
+                    tree = jax.tree_util.tree_unflatten(treedef, leaves)
+                else:
+                    tree = leaves
+                result = RestoreResult(tree, shard_io.header_extras(header),
+                                       step, header["world_version"],
+                                       "replicated")
+        finally:
+            if self.trace is not None:
+                self.trace.record_done(corr_name)
+        self._m_restore.observe(time.perf_counter() - t0)
+        return result
+
+    def restore_shard_slice(self, new_rank: int, new_n: int) -> bytes:
+        """The raw re-slice primitive for a replicated generation: the
+        byte range the *new* world assigns to ``new_rank``, assembled
+        from the writer world's shards via
+        :func:`shard_io.reshard_ranges` (tail re-padded to the new
+        ``shard_spec`` boundary)."""
+        found = self.latest_generation()
+        if found is None:
+            raise CheckpointRestoreError("no complete generation")
+        step, manifests = found
+        header = self._load_header(
+            step, manifests[min(manifests)]["world_size"])
+        total = int(header["total_bytes"])
+        old_n = int(header["world_size"])
+        ranges = shard_io.reshard_ranges(total, old_n, new_rank, new_n)
+        shards: Dict[int, bytes] = {}
+        parts = []
+        for old_rank, off, length in ranges:
+            if old_rank not in shards:
+                data = self._fetch_shard_bytes(step, old_rank)
+                if data is None:
+                    raise CheckpointRestoreError(
+                        f"generation {step}: shard {old_rank} unavailable")
+                shards[old_rank] = data
+            parts.append(shards[old_rank][off:off + length])
+        out = b"".join(parts)
+        _, new_shard = shard_io._shard_spec(total, new_n)
+        if len(out) < new_shard:
+            out += b"\x00" * (new_shard - len(out))
+        return out
+
+    # -- garbage collection --------------------------------------------------
+
+    def _gc(self):
+        """Keep the newest ``keep`` locally-written generations; delete
+        older ones and any partial generation (no local manifest — a
+        crashed write) older than the newest kept one. KV shard chunks
+        of deleted generations are removed too."""
+        rdir = self.rank_dir(self.rank)
+        try:
+            gens = sorted((g for g in os.listdir(rdir) if _is_gen_dir(g)),
+                          key=_gen_step, reverse=True)
+        except OSError:
+            return
+        complete = [g for g in gens if os.path.exists(os.path.join(
+            rdir, g, f"manifest_{self.rank}.json"))]
+        keep = set(complete[:self.keep])
+        newest_kept = _gen_step(complete[0]) if complete else None
+        for g in gens:
+            if g in keep:
+                continue
+            if g not in complete and (newest_kept is None or
+                                      _gen_step(g) >= newest_kept):
+                # an in-flight or future write — never collect it
+                continue
+            step = _gen_step(g)
+            gdir = os.path.join(rdir, g)
+            held = []
+            try:
+                held = [int(fn[len("shard_"):-len(".bin")])
+                        for fn in os.listdir(gdir)
+                        if fn.startswith("shard_") and fn.endswith(".bin")]
+            except OSError:
+                pass
+            shutil.rmtree(gdir, ignore_errors=True)
+            self._m_gc.inc(kind="partial" if g not in complete
+                           else "generation")
+            if self.kv is not None:
+                from ..runner.http_client import delete_large_value
+                for q in held:
+                    try:
+                        delete_large_value(self.kv[0], self.kv[1],
+                                           CKPT_SHARD_KV_SCOPE,
+                                           self._shard_kv_key(step, q))
+                        self._m_gc.inc(kind="kv")
+                    except Exception:
+                        pass

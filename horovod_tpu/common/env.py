@@ -144,6 +144,15 @@ HOROVOD_TPU_COLLECTIVE_DEADLINE = "HOROVOD_TPU_COLLECTIVE_DEADLINE"
 # strike); slots past HOROVOD_ELASTIC_SLOT_FAILURE_LIMIT are out for good
 HOROVOD_ELASTIC_FAILURE_BACKOFF = "HOROVOD_ELASTIC_FAILURE_BACKOFF"
 HOROVOD_ELASTIC_SLOT_FAILURE_LIMIT = "HOROVOD_ELASTIC_SLOT_FAILURE_LIMIT"
+# async sharded checkpointing (ISSUE 9, horovod_tpu/checkpoint/): setting
+# the directory enables the durable tier — TPUState commits snapshot
+# through the CheckpointManager and elastic recovery falls back to the
+# last durable generation when the in-memory commit is gone
+HOROVOD_TPU_CHECKPOINT_DIR = "HOROVOD_TPU_CHECKPOINT_DIR"
+HOROVOD_TPU_CHECKPOINT_INTERVAL_STEPS = "HOROVOD_TPU_CHECKPOINT_INTERVAL_STEPS"
+HOROVOD_TPU_CHECKPOINT_REDUNDANCY = "HOROVOD_TPU_CHECKPOINT_REDUNDANCY"
+HOROVOD_TPU_CHECKPOINT_KEEP = "HOROVOD_TPU_CHECKPOINT_KEEP"
+HOROVOD_TPU_CHECKPOINT_KV_CHUNK_BYTES = "HOROVOD_TPU_CHECKPOINT_KV_CHUNK_BYTES"
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # operations.cc:432
 DEFAULT_CYCLE_TIME_MS = 5.0                        # operations.cc:440
@@ -296,6 +305,11 @@ class Config:
     trace_ring: int = 4096
     trace_interval: float = 5.0
     trace_dump_dir: Optional[str] = None
+    checkpoint_dir: Optional[str] = None
+    checkpoint_interval_steps: int = 0
+    checkpoint_redundancy: int = 1
+    checkpoint_keep: int = 2
+    checkpoint_kv_chunk_bytes: int = 4 * 1024 * 1024
     extra: dict = field(default_factory=dict)
 
     @classmethod
@@ -344,4 +358,13 @@ class Config:
             trace_ring=_get_int(HOROVOD_TPU_TRACE_RING, 4096),
             trace_interval=_get_float(HOROVOD_TPU_TRACE_INTERVAL, 5.0),
             trace_dump_dir=os.environ.get(HOROVOD_TPU_TRACE_DUMP_DIR) or None,
+            checkpoint_dir=os.environ.get(HOROVOD_TPU_CHECKPOINT_DIR)
+            or None,
+            checkpoint_interval_steps=_get_int(
+                HOROVOD_TPU_CHECKPOINT_INTERVAL_STEPS, 0),
+            checkpoint_redundancy=_get_int(
+                HOROVOD_TPU_CHECKPOINT_REDUNDANCY, 1),
+            checkpoint_keep=_get_int(HOROVOD_TPU_CHECKPOINT_KEEP, 2),
+            checkpoint_kv_chunk_bytes=_get_int(
+                HOROVOD_TPU_CHECKPOINT_KV_CHUNK_BYTES, 4 * 1024 * 1024),
         )

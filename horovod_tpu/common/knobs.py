@@ -152,6 +152,35 @@ KNOB_SPECS: Dict[str, dict] = {
         "help": "Seconds a collective may sit outstanding (or a peer "
                 "heartbeat lag) before the watchdog poisons the engine "
                 "and raises the elastic-recoverable error; 0 disables."},
+    # -- async sharded checkpointing (ISSUE 9) ------------------------------
+    "HOROVOD_TPU_CHECKPOINT_DIR": {
+        "type": "str", "default": "",
+        "help": "Checkpoint root directory; setting it enables the "
+                "durable tier (TPUState commits snapshot asynchronously "
+                "through the CheckpointManager and elastic recovery "
+                "falls back to the last durable generation when the "
+                "in-memory commit is gone)."},
+    "HOROVOD_TPU_CHECKPOINT_INTERVAL_STEPS": {
+        "type": "int", "default": "0",
+        "help": "Auto-snapshot every N completed engine steps via the "
+                "step hook (needs a registered state provider); 0 "
+                "leaves snapshots to explicit commit()/snapshot() "
+                "calls."},
+    "HOROVOD_TPU_CHECKPOINT_REDUNDANCY": {
+        "type": "int", "default": "1",
+        "help": "Peer-replica degree: rank r also holds ranks "
+                "(r+1..r+d)%N's shards, so up to d lost hosts restore "
+                "from neighbors over the wire instead of blob storage."},
+    "HOROVOD_TPU_CHECKPOINT_KEEP": {
+        "type": "int", "default": "2",
+        "help": "Complete checkpoint generations retained per rank; "
+                "older ones (and partial generations) are "
+                "garbage-collected."},
+    "HOROVOD_TPU_CHECKPOINT_KV_CHUNK_BYTES": {
+        "type": "int", "default": str(4 * 1024 * 1024),
+        "help": "Chunk size for large-value shard transfers through the "
+                "rendezvous KV (one multi-hundred-MB PUT would fight "
+                "the capped per-request socket timeout)."},
     # -- metrics & telemetry ------------------------------------------------
     "HOROVOD_TPU_METRICS": {
         "type": "bool", "default": "1",

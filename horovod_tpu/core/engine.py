@@ -22,6 +22,7 @@ makes cross-rank readiness implicit. What remains, and lives here:
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -403,6 +404,12 @@ class Engine:
         # heartbeat, and the collective watchdog's peer leg must not
         # mistake that for a hang
         self.on_join_state: Optional[Callable[[bool], None]] = None
+        # checkpoint snapshot hook (ISSUE 9): called with the monotonic
+        # completed-step index at every step_end — GlobalState wires it
+        # to CheckpointManager.on_step for interval-driven async
+        # snapshots riding the step boundary, never the step body
+        self.step_index = 0
+        self.on_step_complete: Optional[Callable[[int], None]] = None
         self._hier_ok: Optional[bool] = None
         # One-shot flag: the next engine-method call is a Join zero-tensor
         # substitute — it must skip its own join round (the join() loop
@@ -548,6 +555,13 @@ class Engine:
         self._in_step_bracket = False
         if self.trace is not None:
             self.trace.record_step(begin=False)
+        self.step_index += 1
+        if self.on_step_complete is not None:
+            try:
+                self.on_step_complete(self.step_index)
+            except Exception:
+                logging.getLogger("horovod_tpu").debug(
+                    "step-complete hook failed", exc_info=True)
 
     def _refresh_world_version(self) -> int:
         """Pick up an elastic world-version bump. A reset normally rebuilds
@@ -1218,6 +1232,14 @@ class Engine:
             self._track(nm, h)
             handles.append(h)
         return handles
+
+    def shard_layout(self, total_bytes: int) -> tuple:
+        """The durable-checkpoint byte-shard layout for this world:
+        ``(padded, shard) = shard_spec(total_bytes, world_size)`` — the
+        same ZeRO-1 padding rule the sharded optimizer uses, exposed so
+        the checkpoint subsystem and the engine can never disagree on
+        who owns which byte range (ISSUE 9)."""
+        return C.shard_spec(int(total_bytes), self.backend.size())
 
     def sharded_step(self, tensors: Sequence, update_fn: Callable,
                      update_key: tuple, state_leaves: Sequence,

@@ -24,6 +24,7 @@ class GlobalState:
         self.metrics_emitter = None
         self.trace_recorder = None
         self.trace_publisher = None
+        self.checkpoint_manager = None
 
     def init(self):
         with self._lock:
@@ -113,6 +114,25 @@ class GlobalState:
                 kv=kv, rank=self.backend.rank(), size=self.backend.size(),
                 collective_deadline=cfg.collective_deadline,
                 escalate=_escalate, flight_dump=_flight_dump)
+        # async sharded checkpointing (ISSUE 9, horovod_tpu/checkpoint/):
+        # the durable tier above the in-memory elastic commit. Rebuilt on
+        # every (re-)init so rank/size/world_version track the live world;
+        # the engine's step hook drives interval snapshots of a registered
+        # provider, and TPUState.save/restore delegate through this
+        # manager when the directory knob is set.
+        if cfg.checkpoint_dir:
+            from ..checkpoint import CheckpointManager
+            self.checkpoint_manager = CheckpointManager(
+                cfg.checkpoint_dir, rank=self.backend.rank(),
+                world_size=self.backend.size(),
+                world_version=self.engine.world_version, kv=kv,
+                redundancy=cfg.checkpoint_redundancy,
+                keep=cfg.checkpoint_keep,
+                kv_chunk_bytes=cfg.checkpoint_kv_chunk_bytes,
+                trace=self.trace_recorder)
+            self.checkpoint_manager.interval_steps = \
+                cfg.checkpoint_interval_steps
+            self.engine.on_step_complete = self.checkpoint_manager.on_step
         # metrics emitter (horovod_tpu/metrics.py): one thread, three sinks
         # — JSONL file, rendezvous-KV publish (feeds the cluster-aggregated
         # GET /metrics on the runner server), Chrome-trace counter tracks
@@ -235,6 +255,17 @@ class GlobalState:
         with self._lock:
             if self.engine is not None:
                 self.engine.stop()
+            if self.checkpoint_manager is not None:
+                # flush the pending/in-flight snapshot — BOUNDED: a clean
+                # shutdown should not lose the last commit's durable
+                # generation (normally sub-second), but this same path
+                # runs on every elastic failure reset, where a write
+                # stuck waiting on a dead peer's replica must not delay
+                # world recovery by the full replica timeout; a dropped
+                # snapshot there is superseded by the post-recovery
+                # commit anyway
+                self.checkpoint_manager.close(flush=True, timeout=10.0)
+                self.checkpoint_manager = None
             if self.metrics_emitter is not None:
                 # final flush: short-lived jobs still leave a JSONL record
                 # and a last KV publish for the scrape endpoint

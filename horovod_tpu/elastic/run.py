@@ -83,6 +83,29 @@ def _is_removed() -> bool:
             st.backend.removed)
 
 
+def _maybe_restore_durable(state, recoveries_counter) -> None:
+    """Recovery tier 2 (ISSUE 9, docs/checkpointing.md): a process with
+    no in-memory commit — a host restarted after preemption — restores
+    from the last durable checkpoint generation before the first sync,
+    so rank 0's subsequent broadcast carries the recovered state instead
+    of freshly-initialized parameters. No-op without a configured
+    ``CheckpointManager`` (HOROVOD_TPU_CHECKPOINT_DIR) or once the state
+    has committed in-memory."""
+    from ..core.state import global_state
+    if global_state().checkpoint_manager is None:
+        return
+    if getattr(state, "_commit_count", 0) > 0:
+        return
+    before = getattr(state, "_durable_step", 0)
+    try:
+        state.restore()
+    except Exception as e:
+        _LOG.warning("durable-restore probe failed: %s", e)
+        return
+    if getattr(state, "_durable_step", 0) > before:
+        recoveries_counter.inc(kind="durable")
+
+
 def run_fn(func, reset):
     @functools.wraps(func)
     def wrapper(state, *args, **kwargs):
@@ -99,6 +122,7 @@ def run_fn(func, reset):
         # peer crash or user-code failure, hosts_updated = membership)
         _m_recoveries = metrics_registry().counter(
             "hvd_tpu_elastic_recoveries_total")
+        _maybe_restore_durable(state, _m_recoveries)
         try:
             while True:
                 if not skip_sync:

@@ -155,6 +155,13 @@ class TPUState(ObjectState):
                  get_rank: Optional[Callable] = None, **kwargs):
         self._pytrees: Dict[str, Any] = {}
         self._saved_pytrees: Dict[str, Any] = {}
+        # durable-tier bookkeeping (ISSUE 9): the number of saves this
+        # process has made — compared against the newest on-disk/peer
+        # generation's step so a SURVIVING process keeps trusting its
+        # in-memory commit while a fresh one (preempted host) restores
+        # from the durable tier
+        self._durable_step = 0
+        self._warned_sharded = False
         if params is not None:
             self._pytrees["params"] = params
         if opt_state is not None:
@@ -190,8 +197,93 @@ class TPUState(ObjectState):
     def save(self):
         self._save_pytrees()
         super().save()
+        self._durable_delegate()
+
+    # -- durable tier (ISSUE 9, horovod_tpu/checkpoint/) --------------------
+
+    @staticmethod
+    def _checkpoint_manager():
+        from ..core.state import global_state
+        return global_state().checkpoint_manager
+
+    def _durable_delegate(self):
+        """When ``HOROVOD_TPU_CHECKPOINT_DIR`` is set (the manager
+        exists), every save also requests an async durable snapshot —
+        off the step path, sharded 1/world_size per rank, peer-redundant
+        (see CheckpointManager). A ZeRO-1 ``ShardedEagerState`` is
+        excluded: its leaves are rank-local shards (the same reason
+        ``broadcast_optimizer_state`` refuses them) — restore re-runs
+        ``opt.init`` on the restored params per
+        docs/sharded_optimizer.md; direct users keep momenta via
+        ``CheckpointManager.snapshot_zero1``."""
+        mgr = self._checkpoint_manager()
+        if mgr is None:
+            return
+        from ..optimizer import ShardedEagerState
+        trees = {k: v for k, v in self._saved_pytrees.items()
+                 if not isinstance(v, ShardedEagerState)}
+        if len(trees) != len(self._saved_pytrees) and \
+                not self._warned_sharded:
+            self._warned_sharded = True
+            _LOG.warning(
+                "durable checkpoint excludes the ZeRO-1 sharded optimizer "
+                "state (rank-local shards; re-run opt.init(params) after a "
+                "durable restore — docs/checkpointing.md). Use "
+                "CheckpointManager.snapshot_zero1 to persist momenta.")
+        self._durable_step += 1
+        mgr.snapshot({"pytrees": trees}, self._durable_step,
+                     extras=dict(self._saved_state))
+
+    def _restore_durable(self, mgr) -> bool:
+        """Load the newest durable generation into this state. Returns
+        False (with a WARNING) when nothing restorable exists or the
+        checkpoint does not fit the live tree — the caller then falls
+        back to the in-memory commit."""
+        import numpy as np
+        import jax
+        from ..optimizer import ShardedEagerState
+        template = {k: jax.tree_util.tree_map(np.asarray, v)
+                    for k, v in self._pytrees.items()
+                    if not isinstance(v, ShardedEagerState)}
+        from ..checkpoint import CheckpointRestoreError
+        try:
+            res = mgr.restore_latest(template={"pytrees": template})
+        except CheckpointRestoreError as e:
+            # the common clean case: a durable-enabled job that simply
+            # has no generation yet (reset before the first commit) —
+            # not warning-worthy
+            _LOG.debug("no durable generation to restore (%s)", e)
+            return False
+        except Exception as e:
+            _LOG.warning("durable restore failed (%s); falling back to "
+                         "the in-memory commit", e)
+            return False
+        for k, tree in res.tree["pytrees"].items():
+            self._pytrees[k] = tree
+            self._saved_pytrees[k] = tree
+        if res.extras:
+            self._saved_state = dict(res.extras)
+            self._set_attrs()
+        self._durable_step = res.step
+        _LOG.info("restored durable checkpoint generation step=%d "
+                  "(world_version=%d, mode=%s)", res.step,
+                  res.world_version, res.mode)
+        return True
 
     def restore(self):
+        # Durable tier first — but only when this process has no
+        # in-memory commit of its own (``_durable_step == 0``: a fresh
+        # process after host preemption, or a crash before the first
+        # commit). A surviving process's in-memory commit is always at
+        # least as new as anything durable (saves precede snapshots), so
+        # it keeps the cheap path and pays no discovery I/O per reset.
+        mgr = self._checkpoint_manager()
+        if mgr is not None and self._durable_step == 0 and \
+                self._restore_durable(mgr):
+            # _restore_durable runs the (single) generation discovery
+            # itself and returns False when nothing restorable exists
+            super().restore()
+            return
         # Host-side only (numpy leaves): restore may run *before* the elastic
         # reset tears down the XLA backend (run.py order: restore → reset),
         # so materializing on-device here would pin arrays of the dying

@@ -112,6 +112,16 @@ FAULT_SPECS: Dict[str, str] = {
     "elastic.reregister": "Inside each attempt of the worker notification "
                           "re-registration after a world reset",
     "elastic.notify": "Inside the driver->worker hosts-updated push",
+    # checkpoint/manager.py
+    "checkpoint.write": "At the top of the background generation write "
+                        "(after device_get, before any file/KV I/O): "
+                        "drop() models a lost snapshot — no files, no "
+                        "manifest, the generation never commits; raise() "
+                        "a failed write (counted, training unaffected)",
+    "checkpoint.restore": "At the top of restore_latest, before "
+                          "generation discovery — hang()/raise() model a "
+                          "restore that must surface to the elastic "
+                          "run-loop instead of wedging recovery",
     # stall_inspector.py
     "stall.publish": "Inside the stall inspector's KV liveness publish",
     # metrics.py

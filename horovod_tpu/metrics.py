@@ -114,6 +114,29 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
     "hvd_tpu_straggler_rank": (
         "gauge", "Rank most often last to arrive over the correlated "
                  "collectives in the merged trace window"),
+    # checkpoint/ (ISSUE 9 async sharded checkpointing)
+    "hvd_tpu_ckpt_snapshots_total": (
+        "counter", "Checkpoint snapshot requests, by outcome (written, "
+                   "skipped when a newer request replaced a pending one, "
+                   "failed)"),
+    "hvd_tpu_ckpt_bytes_total": (
+        "counter", "Checkpoint bytes moved, by kind (shard = own shard "
+                   "written, replica = peer shard held, manifest, "
+                   "restore = shard bytes read back)"),
+    "hvd_tpu_ckpt_restore_seconds": (
+        "histogram", "Wall time of one durable-generation restore "
+                     "(discovery, shard sourcing, checksum, decode)"),
+    "hvd_tpu_ckpt_gc_total": (
+        "counter", "Checkpoint generations garbage-collected, by kind "
+                   "(generation, partial = crashed write, kv = chunked "
+                   "shard values dropped from the rendezvous KV)"),
+    "hvd_tpu_ckpt_snapshot_stall_seconds": (
+        "histogram", "Step-path time spent inside snapshot() stamping "
+                     "the async request (the stall budget — near zero "
+                     "by construction; bench reports the per-step mean)"),
+    "hvd_tpu_ckpt_last_step": (
+        "gauge", "Step of the last locally-written checkpoint "
+                 "generation"),
     # stall_inspector.py
     "hvd_tpu_stall_publish_failures_total": (
         "counter", "Stall-inspector KV liveness publishes that failed"),
@@ -142,7 +165,8 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
     # elastic/run.py
     "hvd_tpu_elastic_recoveries_total": (
         "counter", "Elastic run-loop recovery events, by kind (internal, "
-                   "raw_runtime, hosts_updated)"),
+                   "raw_runtime, hosts_updated, durable = restored from "
+                   "a durable checkpoint generation)"),
     # elastic/driver.py
     "hvd_tpu_elastic_world_version": (
         "gauge", "Current elastic world version (bumps on every resume)"),

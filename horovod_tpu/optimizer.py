@@ -601,6 +601,64 @@ class DistributedEagerOptimizer:
                              "first.")
         return st.engine
 
+    # -- durable checkpointing of the ZeRO-1 state (ISSUE 9) ---------------
+
+    def checkpoint_payload(self, opt_state, params):
+        """``(shards, inner_state, layout)`` for
+        ``CheckpointManager.snapshot_zero1``: this rank's per-bucket flat
+        parameter shards, the shard-shaped inner optax state, and the
+        FROZEN bucket layout — each rank persists exactly its 1/world
+        slice, and a restore at a different world size re-slices it
+        (``checkpoint.shard_io.zero1_reshard``)."""
+        if not isinstance(opt_state, ShardedEagerState):
+            raise ValueError(
+                "checkpoint_payload needs a ZeRO-1 ShardedEagerState "
+                "(sharded=True); replicated states checkpoint through "
+                "CheckpointManager.snapshot directly")
+        p_leaves, treedef = jax.tree_util.tree_flatten(params)
+        leaves = [jnp.asarray(l) for l in p_leaves]
+        layout = self._sharded_layout(leaves, treedef)
+        return opt_state.shards, opt_state.inner_state, layout
+
+    def restore_from_durable(self, restore_tree, params_template):
+        """Rebuild ``(params, ShardedEagerState)`` for THIS world from a
+        zero1 ``RestoreResult.tree`` (the reshard dict): full parameters
+        come from the unpacked logical buckets, the master-copy shards
+        and inner state from the new-world reslice — optimizer momenta
+        survive an N→M elastic resize."""
+        from .checkpoint import shard_io
+        header = restore_tree["header"]
+        p_leaves, treedef = jax.tree_util.tree_flatten(params_template)
+        leaves = [jnp.asarray(l) for l in p_leaves]
+        layout = self._sharded_layout(leaves, treedef)
+        if len(layout) != len(header["buckets"]) or any(
+                tuple(l[0]) != tuple(b["idxs"]) or l[2] != b["total"]
+                for l, b in zip(layout, header["buckets"])):
+            raise ValueError(
+                "durable ZeRO-1 checkpoint bucket layout does not match "
+                "this optimizer's (fusion threshold or tree changed); "
+                "restore the parameters and re-run init() instead")
+        outs = [None] * len(leaves)
+        for spec, flat in zip(header["buckets"],
+                              restore_tree["full_buckets"]):
+            for i, vals in shard_io.unpack_bucket(flat, spec).items():
+                outs[i] = jnp.asarray(vals).reshape(leaves[i].shape) \
+                    .astype(leaves[i].dtype)
+        params = jax.tree_util.tree_unflatten(treedef, outs)
+        shards = tuple(jnp.asarray(s) for s in restore_tree["shards"])
+        st_template = self.inner.init(list(shards))
+        st_leaves, st_def = jax.tree_util.tree_flatten(st_template)
+        restored = restore_tree["state_leaves"]
+        if len(restored) != len(st_leaves):
+            raise ValueError(
+                f"inner optimizer state has {len(st_leaves)} leaves, "
+                f"checkpoint has {len(restored)} — different inner "
+                f"transform; re-run init() instead")
+        inner_state = jax.tree_util.tree_unflatten(
+            st_def, [jnp.asarray(r).reshape(jnp.asarray(t).shape).astype(
+                jnp.asarray(t).dtype) for r, t in zip(restored, st_leaves)])
+        return params, ShardedEagerState(inner_state, shards)
+
     def _sparse_ks(self, grads, leaves, treedef):
         """Per-leaf sparse row budget (None = dense): a grad leaf is sparse
         when its tree path contains one of the ``sparse_rows`` patterns.

@@ -80,6 +80,110 @@ def fetch_server_clock(addr: str, port: int,
     return ((t0 + t1) / 2.0, float(payload["ts"]), t1 - t0)
 
 
+def delete_data_from_kvstore(addr: str, port: int, scope: str, key: str,
+                             timeout: float = 10.0) -> None:
+    """Idempotent DELETE of one key (checkpoint GC drops stale shard
+    chunks from the KV). A 404 — already gone — is success."""
+    req = urllib.request.Request(_url(addr, port, scope, key),
+                                 method="DELETE")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout):
+            pass
+    except urllib.error.HTTPError as e:
+        if e.code != 404:
+            raise
+
+
+# ---------------------------------------------------------------------------
+# Chunked large-value transfer (ISSUE 9): checkpoint shards are orders of
+# magnitude bigger than any control-plane value — one multi-hundred-MB PUT
+# would ride a single socket write against the capped per-request timeout.
+# Values are split into fixed-size chunk keys (``<key>.c<i>``) with a meta
+# record under the bare key written LAST, so a reader that sees the meta
+# can fetch every chunk; the sha256 in the meta catches torn interleavings
+# of two racing writers (the reader retries until a consistent set lands).
+# ---------------------------------------------------------------------------
+
+DEFAULT_KV_CHUNK_BYTES = 4 * 1024 * 1024
+
+
+def put_large_value(addr: str, port: int, scope: str, key: str,
+                    value: bytes, chunk_bytes: int = DEFAULT_KV_CHUNK_BYTES,
+                    timeout: float = 60.0) -> int:
+    """Chunked PUT: writes ``ceil(len/chunk_bytes)`` chunk keys then the
+    meta record. Returns the number of chunks written."""
+    import hashlib
+    import json
+    chunk_bytes = max(int(chunk_bytes), 1)
+    n = max(1, -(-len(value) // chunk_bytes))
+    for i in range(n):
+        put_data_into_kvstore(addr, port, scope, f"{key}.c{i}",
+                              value[i * chunk_bytes:(i + 1) * chunk_bytes],
+                              timeout=timeout)
+    meta = {"chunks": n, "bytes": len(value),
+            "sha256": hashlib.sha256(value).hexdigest(),
+            "chunk_bytes": chunk_bytes}
+    put_data_into_kvstore(addr, port, scope, key,
+                          json.dumps(meta).encode(), timeout=timeout)
+    return n
+
+
+def read_large_value(addr: str, port: int, scope: str, key: str,
+                     timeout: float = 60.0) -> bytes:
+    """Chunked GET: long-polls the meta record (the writer publishes it
+    last), fetches every chunk, and verifies the meta's sha256 —
+    retrying inside the deadline on a torn read (a concurrent re-write
+    of the same key)."""
+    import hashlib
+    import json
+    deadline = time.monotonic() + timeout
+    last_err: Optional[Exception] = None
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(
+                f"chunked KV read {scope}/{key} from {addr}:{port} timed "
+                f"out after {timeout}s: {last_err}")
+        try:
+            meta = json.loads(read_data_from_kvstore(
+                addr, port, scope, key, timeout=remaining))
+            parts = [read_data_from_kvstore(
+                addr, port, scope, f"{key}.c{i}",
+                timeout=max(deadline - time.monotonic(), 0.1))
+                for i in range(int(meta["chunks"]))]
+            value = b"".join(parts)
+            if len(value) == int(meta["bytes"]) and \
+                    hashlib.sha256(value).hexdigest() == meta["sha256"]:
+                return value
+            last_err = ValueError(
+                f"chunk set inconsistent with meta ({len(value)} bytes)")
+        except TimeoutError:
+            raise
+        except Exception as e:
+            last_err = e
+        time.sleep(0.1)
+
+
+def delete_large_value(addr: str, port: int, scope: str, key: str,
+                       timeout: float = 10.0) -> None:
+    """Chunked DELETE: remove the meta first (hides the value from
+    readers), then the chunks. Best-effort on an absent/garbled meta —
+    GC must be idempotent."""
+    import json
+    chunks = 0
+    try:
+        meta = json.loads(read_data_from_kvstore(addr, port, scope, key,
+                                                 timeout=1.0,
+                                                 poll_interval=0.05))
+        chunks = int(meta.get("chunks", 0))
+    except Exception:
+        pass
+    delete_data_from_kvstore(addr, port, scope, key, timeout=timeout)
+    for i in range(chunks):
+        delete_data_from_kvstore(addr, port, scope, f"{key}.c{i}",
+                                 timeout=timeout)
+
+
 def put_data_into_kvstore(addr: str, port: int, scope: str, key: str,
                           value: bytes, timeout: float = 60.0,
                           retries: int = 3,

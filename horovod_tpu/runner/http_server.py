@@ -81,6 +81,15 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", "0")
         self.end_headers()
 
+    def do_DELETE(self):  # noqa: N802
+        # idempotent key removal (checkpoint GC drops stale chunked shard
+        # values; see http_client.delete_data_from_kvstore)
+        scope, key = self._split()
+        code = self.server.handle_delete(scope, key, self)
+        self.send_response(code)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
 
 class KVStoreServer(ThreadingHTTPServer):
     """Plain scoped KV store over HTTP (reference http_server.py:175-242).
@@ -190,6 +199,11 @@ class KVStoreServer(ThreadingHTTPServer):
         with self._lock:
             self._store[scope][key] = value
         return OK
+
+    def handle_delete(self, scope: str, key: str, handler) -> int:
+        with self._lock:
+            existed = self._store.get(scope, {}).pop(key, None) is not None
+        return OK if existed else NOT_FOUND
 
     # -- lifecycle ----------------------------------------------------------
 

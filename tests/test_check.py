@@ -30,7 +30,8 @@ def _load_check():
     return mod
 
 
-LINTS = ("lockcheck", "knobs", "metrics", "faults", "trace_schema")
+LINTS = ("lockcheck", "knobs", "metrics", "faults", "trace_schema",
+         "ckpt_manifest")
 
 
 @pytest.mark.parametrize("lint", LINTS)

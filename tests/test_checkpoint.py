@@ -1,0 +1,651 @@
+"""Async sharded checkpointing (ISSUE 9): serialization/reshard math,
+manifests + the commit barrier, the async manager (double-buffering,
+peer-redundant restore, GC, failpoints), the chunked KV transfer, and
+the TPUState durable delegation."""
+
+import json
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import faults
+from horovod_tpu.checkpoint import (CheckpointManager,
+                                    CheckpointRestoreError, build_manifest,
+                                    checksum, generation_complete,
+                                    reshard_ranges, validate_manifest,
+                                    zero1_reshard)
+from horovod_tpu.checkpoint import manifest as mf
+from horovod_tpu.checkpoint import shard_io
+from horovod_tpu.metrics import registry
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+def _tree(seed=0, kb=4):
+    rng = np.random.RandomState(seed)
+    return {"w": rng.rand(kb * 64, 4).astype(np.float32),
+            "b": rng.rand(7).astype(np.float32),
+            "n": np.int32(3)}
+
+
+def _write_world(d, tree, n, step=1, redundancy=1, kv=None, extras=None):
+    mgrs = [CheckpointManager(d, rank=r, world_size=n,
+                              redundancy=redundancy, kv=kv)
+            for r in range(n)]
+    try:
+        for m in mgrs:
+            assert m.snapshot(tree, step=step, extras=extras)
+        for m in mgrs:
+            assert m.wait_idle(60)
+    finally:
+        for m in mgrs:
+            m.close(flush=False)
+    return mgrs
+
+
+# ---------------------------------------------------------------------------
+# shard_io: the flat-stream layout + N→M re-slice math
+# ---------------------------------------------------------------------------
+
+class TestShardIO:
+    def test_encode_decode_round_trip(self):
+        tree = _tree()
+        import jax
+        leaves, _ = jax.tree_util.tree_flatten(tree)
+        leaves = [np.asarray(l) for l in leaves]
+        header = shard_io.make_header(leaves, step=1, world_version=0,
+                                      world_size=4)
+        stream = shard_io.encode_leaves(leaves)
+        assert len(stream) == header["total_bytes"]
+        out = shard_io.decode_leaves(stream, header)
+        for a, b in zip(leaves, out):
+            np.testing.assert_array_equal(a, b)
+
+    def test_shards_cover_stream_with_padding(self):
+        stream = bytes(range(256)) * 3  # 768 bytes
+        for n in (1, 2, 3, 5, 7):
+            shards = [shard_io.shard_of(stream, r, n) for r in range(n)]
+            assert len({len(s) for s in shards}) == 1  # uniform shard size
+            joined = b"".join(shards)
+            assert joined[:len(stream)] == stream
+            assert set(joined[len(stream):]) <= {0}  # zero tail padding
+
+    @pytest.mark.parametrize("old_n,new_n", [(4, 2), (2, 4), (4, 1),
+                                             (1, 4), (3, 5), (5, 3)])
+    def test_reshard_ranges_exact(self, old_n, new_n):
+        """The elastic-resize re-slice: concatenating every new rank's
+        ranges, read out of the old shards, reproduces the stream."""
+        stream = os.urandom(1037)  # awkward size: padding on both worlds
+        old = [shard_io.shard_of(stream, r, old_n) for r in range(old_n)]
+        rebuilt = b""
+        for nr in range(new_n):
+            for old_rank, off, length in reshard_ranges(
+                    len(stream), old_n, nr, new_n):
+                rebuilt += old[old_rank][off:off + length]
+        assert rebuilt == stream
+
+    def test_zero1_state_bucket_assignment(self):
+        """Optax-style state leaf runs (mu[b0..], nu[b0..]) map onto
+        buckets cyclically per run; scalars stay replicated. Two buckets
+        share a shard size — the ambiguous case the run rule resolves."""
+        buckets = [{"shard": 5}, {"shard": 5}, {"shard": 3}]
+        leaves = [np.zeros(()),                       # count -> None
+                  np.zeros(5), np.zeros(5), np.zeros(3),   # mu run
+                  np.zeros(5), np.zeros(5), np.zeros(3)]   # nu run
+        got = shard_io._assign_state_buckets(leaves, buckets)
+        assert got == [None, 0, 1, 2, 0, 1, 2]
+
+    def test_zero1_reshard_parity(self):
+        """N=3 → M=2: reassembled full buckets equal the logical flat
+        params, and the new shards re-slice them exactly (adam momenta
+        included)."""
+        import optax
+        layout = [((0, 1), (10, 7), 17, 6), ((2,), (7,), 7, 3)]
+        rng = np.random.RandomState(1)
+        full0, full1 = rng.rand(18).astype(np.float32), \
+            rng.rand(9).astype(np.float32)
+        full0[17:] = 0
+        full1[7:] = 0
+        opt = optax.adam(1e-3)
+        payloads, header = {}, None
+        for r in range(3):
+            shards = [full0[r * 6:(r + 1) * 6], full1[r * 3:(r + 1) * 3]]
+            st = opt.init([np.asarray(s) for s in shards])
+            header = shard_io.zero1_header(layout, shards,
+                                           _flatten(st), step=2,
+                                           world_version=1, world_size=3)
+            payloads[r] = shard_io.zero1_payload(shards, _flatten(st))
+        for new_rank in range(2):
+            re = zero1_reshard(header, payloads, new_rank, 2)
+            np.testing.assert_array_equal(re["full_buckets"][0], full0[:17])
+            np.testing.assert_array_equal(re["full_buckets"][1], full1[:7])
+            # new world: bucket0 shard = ceil(17/2) = 9
+            assert re["shards"][0].shape == (9,)
+            pad0 = np.concatenate([full0[:17], np.zeros(1, np.float32)])
+            np.testing.assert_array_equal(
+                re["shards"][0], pad0[new_rank * 9:(new_rank + 1) * 9])
+
+    def test_zero1_reshard_missing_rank_raises(self):
+        layout = [((0,), (4,), 4, 2)]
+        shards = [np.arange(2, dtype=np.float32)]
+        header = shard_io.zero1_header(layout, shards, [], step=1,
+                                       world_version=0, world_size=2)
+        with pytest.raises(ValueError, match="missing"):
+            zero1_reshard(header, {0: shard_io.zero1_payload(shards, [])},
+                          0, 1)
+
+
+def _flatten(tree):
+    import jax
+    return [np.asarray(l) for l in jax.tree_util.tree_flatten(tree)[0]]
+
+
+# ---------------------------------------------------------------------------
+# manifests + commit barrier
+# ---------------------------------------------------------------------------
+
+class TestManifest:
+    def _man(self, rank, n=2, step=1, wv=0, digest="d" * 8, cs=None):
+        cs = cs or {rank: "a" * 64}
+        return build_manifest(rank, step=step, world_version=wv,
+                              world_size=n, layout_digest=digest,
+                              shard_checksums=cs,
+                              shard_bytes={k: 10 for k in cs},
+                              holds=list(cs))
+
+    def test_schema_round_trip(self):
+        m = json.loads(json.dumps(self._man(0)))
+        assert validate_manifest(m) == []
+
+    def test_schema_rejects(self):
+        m = self._man(0)
+        del m["layout_digest"]
+        assert any("layout_digest" in e for e in validate_manifest(m))
+        m = self._man(0)
+        m["shard_checksums"] = {"0": "nothex"}
+        assert any("sha256" in e for e in validate_manifest(m))
+        m = self._man(1, n=1)
+        assert any("outside world" in e for e in validate_manifest(m))
+
+    def test_barrier_complete_and_stale_wv(self):
+        mans = {0: self._man(0), 1: self._man(1, cs={1: "b" * 64})}
+        ok, errs = generation_complete(mans)
+        assert ok, errs
+        mans[1]["world_version"] = 7
+        ok, errs = generation_complete(mans)
+        assert not ok and any("stale world_version" in e for e in errs)
+
+    def test_barrier_partial_and_checksum_mismatch(self):
+        ok, errs = generation_complete({0: self._man(0)})
+        assert not ok and any("missing manifests" in e for e in errs)
+        mans = {0: self._man(0, cs={0: "a" * 64, 1: "c" * 64}),
+                1: self._man(1, cs={1: "b" * 64})}
+        ok, errs = generation_complete(mans)
+        assert not ok and any("checksum mismatch" in e for e in errs)
+
+    def test_restorable_covers_lost_host(self):
+        """One manifest gone (lost host) but its shard held by the
+        survivor → restorable; shard held by nobody → not."""
+        mans = {0: self._man(0, cs={0: "a" * 64, 1: "b" * 64})}
+        mans[0]["holds"] = [0, 1]
+        ok, errs = mf.generation_restorable(mans)
+        assert ok, errs
+        lone = {0: self._man(0)}
+        ok, errs = mf.generation_restorable(lone)
+        assert not ok and any("held by no surviving rank" in e
+                              for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager
+# ---------------------------------------------------------------------------
+
+class TestManager:
+    def test_round_trip_with_template_and_extras(self, tmp_path):
+        tree = _tree()
+        _write_world(str(tmp_path), tree, n=3, step=4,
+                     extras={"batch": 9})
+        m = CheckpointManager(str(tmp_path), rank=0, world_size=3)
+        try:
+            res = m.restore_latest(template=tree)
+            assert res.step == 4 and res.mode == "replicated"
+            assert res.extras == {"batch": 9}
+            np.testing.assert_array_equal(res.tree["w"], tree["w"])
+            assert int(res.tree["n"]) == 3
+        finally:
+            m.close(flush=False)
+
+    def test_snapshot_is_async_and_double_buffered(self, tmp_path):
+        """The step path never blocks on a write: with the writer held
+        at the failpoint, extra requests replace the pending slot
+        (counted skipped) and snapshot() stays ~instant."""
+        reg = registry()
+        skipped0 = reg.counter("hvd_tpu_ckpt_snapshots_total").value(
+            outcome="skipped")
+        faults.arm("checkpoint.write=1*delay(0.5)")
+        m = CheckpointManager(str(tmp_path), rank=0, world_size=1)
+        try:
+            tree = _tree()
+            t0 = time.perf_counter()
+            for s in range(1, 6):
+                m.snapshot(tree, step=s)
+            stall = time.perf_counter() - t0
+            assert stall < 0.3, f"snapshot() blocked the step path: {stall}"
+            assert m.wait_idle(30)
+            assert reg.counter("hvd_tpu_ckpt_snapshots_total").value(
+                outcome="skipped") > skipped0
+            # the newest request won the pending slot
+            assert m.last_written_step == 5
+        finally:
+            m.close(flush=False)
+
+    def test_write_drop_failpoint_never_commits(self, tmp_path):
+        """drop() on checkpoint.write models a lost snapshot: no files,
+        no manifest — and restore refuses the void loudly."""
+        reg = registry()
+        failed0 = reg.counter("hvd_tpu_ckpt_snapshots_total").value(
+            outcome="failed")
+        faults.arm("checkpoint.write=1*drop()")
+        m = CheckpointManager(str(tmp_path), rank=0, world_size=1)
+        try:
+            m.snapshot(_tree(), step=1)
+            assert m.wait_idle(30)
+            assert reg.counter("hvd_tpu_ckpt_snapshots_total").value(
+                outcome="failed") == failed0 + 1
+            assert m.latest_generation() is None
+            with pytest.raises(CheckpointRestoreError):
+                m.restore_latest()
+            # the next (unarmed) snapshot commits normally
+            m.snapshot(_tree(), step=2)
+            assert m.wait_idle(30)
+            assert m.latest_generation()[0] == 2
+        finally:
+            m.close(flush=False)
+
+    def test_restore_failpoint_surfaces(self, tmp_path):
+        _write_world(str(tmp_path), _tree(), n=1)
+        faults.arm("checkpoint.restore=1*raise(HorovodInternalError)")
+        m = CheckpointManager(str(tmp_path), rank=0, world_size=1)
+        try:
+            from horovod_tpu.common.exceptions import HorovodInternalError
+            with pytest.raises(HorovodInternalError):
+                m.restore_latest()
+            faults.disarm()
+            assert m.restore_latest(template=_tree()).step == 1
+        finally:
+            m.close(flush=False)
+
+    def test_peer_redundant_restore_disk(self, tmp_path):
+        """A lost host (rank dir deleted): its shard restores from the
+        neighbor's replica; with TWO of three hosts lost, redundancy 1
+        is exceeded and restore refuses."""
+        tree = _tree(kb=8)
+        _write_world(str(tmp_path), tree, n=3)
+        shutil.rmtree(tmp_path / "rank1")
+        m = CheckpointManager(str(tmp_path), rank=0, world_size=3)
+        try:
+            res = m.restore_latest(template=tree)
+            np.testing.assert_array_equal(res.tree["w"], tree["w"])
+        finally:
+            m.close(flush=False)
+        shutil.rmtree(tmp_path / "rank2")
+        m = CheckpointManager(str(tmp_path), rank=0, world_size=3)
+        try:
+            with pytest.raises(CheckpointRestoreError):
+                m.restore_latest(template=tree)
+        finally:
+            m.close(flush=False)
+
+    def test_corrupt_replica_rejected(self, tmp_path):
+        """A bit-flipped shard fails the manifest checksum at restore."""
+        tree = _tree()
+        _write_world(str(tmp_path), tree, n=2)
+        shutil.rmtree(tmp_path / "rank1")
+        # corrupt rank 0's replica of shard 1
+        path = tmp_path / "rank0" / "gen1" / "shard_1.bin"
+        data = bytearray(path.read_bytes())
+        data[0] ^= 0xFF
+        path.write_bytes(bytes(data))
+        m = CheckpointManager(str(tmp_path), rank=0, world_size=2)
+        try:
+            with pytest.raises(CheckpointRestoreError,
+                               match="checksum mismatch"):
+                m.restore_latest(template=tree)
+        finally:
+            m.close(flush=False)
+
+    def test_reshard_restore_n4_to_n2_and_slice(self, tmp_path):
+        """ISSUE acceptance: a generation written at np=4 restores at
+        np=2 (and np=1), and restore_shard_slice's byte ranges re-slice
+        the stream against the new world's shard_spec padding."""
+        import jax
+        tree = _tree(seed=3)
+        _write_world(str(tmp_path), tree, n=4)
+        for new_n in (2, 1):
+            m = CheckpointManager(str(tmp_path), rank=0, world_size=new_n)
+            try:
+                res = m.restore_latest(template=tree)
+                for a, b in zip(jax.tree_util.tree_leaves(tree),
+                                jax.tree_util.tree_leaves(res.tree)):
+                    np.testing.assert_array_equal(np.asarray(a),
+                                                  np.asarray(b))
+                stream = shard_io.encode_leaves(
+                    [np.asarray(l)
+                     for l in jax.tree_util.tree_leaves(tree)])
+                joined = b"".join(m.restore_shard_slice(r, new_n)
+                                  for r in range(new_n))
+                assert joined[:len(stream)] == stream
+            finally:
+                m.close(flush=False)
+
+    def test_gc_keeps_newest_and_drops_partials(self, tmp_path):
+        reg = registry()
+        gc0 = reg.counter("hvd_tpu_ckpt_gc_total").total()
+        m = CheckpointManager(str(tmp_path), rank=0, world_size=1, keep=2)
+        try:
+            # a partial generation (no manifest): a crashed write
+            partial = tmp_path / "rank0" / "gen1"
+            partial.mkdir(parents=True)
+            (partial / "shard_0.bin").write_bytes(b"junk")
+            for s in (2, 3, 4):
+                m.snapshot(_tree(seed=s), step=s)
+                assert m.wait_idle(30)
+            gens = sorted(os.listdir(tmp_path / "rank0"))
+            assert gens == ["gen3", "gen4"], gens
+            assert reg.counter("hvd_tpu_ckpt_gc_total").total() > gc0
+        finally:
+            m.close(flush=False)
+
+    def test_zero1_manager_round_trip_with_optimizer(self, tmp_path):
+        """End-to-end ZeRO-1 durable path at the optimizer level: the
+        sharded state written at np=1 restores through
+        restore_from_durable with momenta intact."""
+        import jax.numpy as jnp
+        import optax
+        from horovod_tpu.optimizer import DistributedEagerOptimizer
+        hvd.init()
+        opt = DistributedEagerOptimizer(optax.adam(1e-3), sharded=True,
+                                        op=hvd.Sum)
+        params = {"w": jnp.arange(12, dtype=jnp.float32),
+                  "b": jnp.ones((5,), jnp.float32)}
+        state = opt.init(params)
+        # make momenta non-trivial
+        grads = {"w": jnp.ones((12,), jnp.float32),
+                 "b": jnp.full((5,), 2.0, jnp.float32)}
+        params2, state2 = opt.update_and_apply(grads, state, params)
+        shards, inner, layout = opt.checkpoint_payload(state2, params2)
+        m = CheckpointManager(str(tmp_path), rank=0, world_size=1)
+        try:
+            assert m.snapshot_zero1(shards, inner, layout, step=1)
+            assert m.wait_idle(30)
+            res = m.restore_latest()
+            assert res.mode == "zero1"
+            r_params, r_state = opt.restore_from_durable(res.tree, params2)
+            for a, b in zip(_flatten(params2), _flatten(r_params)):
+                np.testing.assert_array_equal(a, b)
+            for a, b in zip(_flatten(state2), _flatten(r_state)):
+                np.testing.assert_array_equal(a, b)
+            # the restored state drives the same next step bitwise
+            p3a, s3a = opt.update_and_apply(grads, state2, params2)
+            p3b, s3b = opt.update_and_apply(grads, r_state, r_params)
+            for a, b in zip(_flatten(p3a), _flatten(p3b)):
+                np.testing.assert_array_equal(a, b)
+        finally:
+            m.close(flush=False)
+
+
+# ---------------------------------------------------------------------------
+# chunked large-value KV transfer + KV-backed peer restore
+# ---------------------------------------------------------------------------
+
+class TestKVTransfer:
+    @pytest.fixture
+    def kv_server(self):
+        from horovod_tpu.runner.http_server import KVStoreServer
+        server = KVStoreServer(("127.0.0.1", 0))
+        server.start()
+        yield server
+        faults.disarm()
+        server.stop()
+
+    def test_chunked_round_trip_and_delete(self, kv_server):
+        from horovod_tpu.runner.http_client import (
+            delete_large_value, put_large_value, read_large_value)
+        kv = ("127.0.0.1", kv_server.port)
+        value = os.urandom(300_000)
+        n = put_large_value(*kv, "ckptshard", "g1.r0", value,
+                            chunk_bytes=65536)
+        assert n == 5  # ceil(300000/65536)
+        got = read_large_value(*kv, "ckptshard", "g1.r0", timeout=10)
+        assert got == value
+        delete_large_value(*kv, "ckptshard", "g1.r0")
+        with pytest.raises(TimeoutError):
+            read_large_value(*kv, "ckptshard", "g1.r0", timeout=0.5)
+        # server-side store is clean of chunk keys too
+        with kv_server._lock:
+            assert not kv_server._store.get("ckptshard")
+
+    def test_read_retries_torn_write(self, kv_server):
+        """Meta present but a chunk inconsistent (torn interleaving):
+        the reader retries until the writer completes."""
+        import threading
+        from horovod_tpu.runner.http_client import (put_data_into_kvstore,
+                                                    put_large_value,
+                                                    read_large_value)
+        kv = ("127.0.0.1", kv_server.port)
+        value = os.urandom(100_000)
+        import hashlib
+        meta = {"chunks": 2, "bytes": len(value),
+                "sha256": hashlib.sha256(value).hexdigest(),
+                "chunk_bytes": 65536}
+        # torn state: meta + first chunk only
+        put_data_into_kvstore(*kv, "ckptshard", "k.c0", value[:65536])
+        put_data_into_kvstore(*kv, "ckptshard", "k",
+                              json.dumps(meta).encode())
+
+        def _complete():
+            time.sleep(0.3)
+            put_large_value(*kv, "ckptshard", "k", value,
+                            chunk_bytes=65536)
+
+        t = threading.Thread(target=_complete)
+        t.start()
+        try:
+            assert read_large_value(*kv, "ckptshard", "k",
+                                    timeout=10) == value
+        finally:
+            t.join()
+
+    def test_kv_only_restore_after_downsize(self, kv_server, tmp_path):
+        """An np=3 world's generation lives ONLY in the KV (manifests,
+        header, chunked shards) for a restorer with a private directory
+        at np=1: the manifest probe must widen past the restorer's own
+        world size to the writer world the first hit advertises —
+        otherwise ranks >= 1 look unpublished and coverage fails."""
+        kv = ("127.0.0.1", kv_server.port)
+        tree = _tree(seed=6)
+        _write_world(str(tmp_path), tree, n=3, kv=kv)
+        m = CheckpointManager(str(tmp_path / "private"), rank=0,
+                              world_size=1, kv=kv)
+        try:
+            res = m.restore_latest(template=tree)
+            np.testing.assert_array_equal(res.tree["w"], tree["w"])
+            assert res.step == 1
+        finally:
+            m.close(flush=False)
+
+    def test_kv_mediated_peer_restore(self, kv_server, tmp_path):
+        """The wire path proper: rank 1's disk is GONE and the restorer
+        has no shared-fs view of it — rank 0 re-publishes its replica to
+        the KV during restore, and rank 1 fetches it over the wire."""
+        kv = ("127.0.0.1", kv_server.port)
+        tree = _tree(seed=5)
+        _write_world(str(tmp_path), tree, n=2, kv=kv)
+        # wipe the KV (a restarted rendezvous server after preemption)
+        # and rank 1's disk
+        with kv_server._lock:
+            kv_server._store.clear()
+        shutil.rmtree(tmp_path / "rank1")
+        # rank 1 restores into a PRIVATE directory: its only route to
+        # shard 1 is rank 0's replica via the KV
+        m0 = CheckpointManager(str(tmp_path), rank=0, world_size=2,
+                               kv=kv)
+        lonely = tmp_path / "lonely"
+        m1 = CheckpointManager(str(lonely), rank=1, world_size=2, kv=kv,
+                               kv_timeout=15.0)
+        try:
+            # rank 0's restore re-publishes everything it holds — its
+            # shards (0 AND the replica of 1), its manifest, the header
+            res0 = m0.restore_latest(template=tree)
+            np.testing.assert_array_equal(res0.tree["w"], tree["w"])
+            res1 = m1.restore_latest(template=tree)
+            np.testing.assert_array_equal(res1.tree["w"], tree["w"])
+        finally:
+            m0.close(flush=False)
+            m1.close(flush=False)
+
+
+# ---------------------------------------------------------------------------
+# TPUState durable delegation + elastic integration (single process)
+# ---------------------------------------------------------------------------
+
+class TestTPUStateDurable:
+    @pytest.fixture
+    def ckpt_world(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HOROVOD_TPU_CHECKPOINT_DIR", str(tmp_path))
+        hvd.shutdown()
+        hvd.init()
+        yield tmp_path
+        hvd.shutdown()
+        monkeypatch.delenv("HOROVOD_TPU_CHECKPOINT_DIR", raising=False)
+        hvd.init()
+
+    def test_save_snapshots_and_fresh_state_restores(self, ckpt_world):
+        """The durable-restore proof at state level: commit through
+        TPUState, then a FRESH state (no in-memory commit — the
+        preempted-host case) restores the durable generation bitwise."""
+        from horovod_tpu.core.state import global_state
+        import jax.numpy as jnp
+        mgr = global_state().checkpoint_manager
+        assert mgr is not None
+        params = {"w": jnp.arange(6, dtype=jnp.float32) * 2}
+        state = hvd.elastic.TPUState(params=params, batch=0)
+        state.batch = 7
+        state.commit()
+        assert mgr.wait_idle(30)
+        assert mgr.last_written_step == 1
+        fresh = hvd.elastic.TPUState(
+            params={"w": jnp.zeros(6, jnp.float32)}, batch=0)
+        fresh.restore()
+        np.testing.assert_array_equal(np.asarray(fresh.params["w"]),
+                                      np.arange(6, dtype=np.float32) * 2)
+        assert fresh.batch == 7
+
+    def test_in_memory_commit_stays_authoritative(self, ckpt_world):
+        """A SURVIVING process restores its own in-memory commit even
+        with durable generations on disk (saves precede snapshots, so
+        in-memory is never older)."""
+        import jax.numpy as jnp
+        from horovod_tpu.core.state import global_state
+        state = hvd.elastic.TPUState(
+            params={"w": jnp.ones(4, jnp.float32)}, batch=0)
+        state.batch = 3
+        state.commit()
+        assert global_state().checkpoint_manager.wait_idle(30)
+        # mutate WITHOUT committing, then restore: in-memory commit wins
+        state.batch = 99
+        state.params = {"w": jnp.zeros(4, jnp.float32)}
+        state.restore()
+        assert state.batch == 3
+        np.testing.assert_array_equal(np.asarray(state.params["w"]),
+                                      np.ones(4, np.float32))
+
+    def test_elastic_run_restores_durable_before_first_sync(
+            self, ckpt_world):
+        """The run-loop integration: @hvd.elastic.run on a fresh state
+        picks up the durable generation before training starts, and the
+        durable recovery is counted."""
+        import jax.numpy as jnp
+        from horovod_tpu.core.state import global_state
+        reg = registry()
+        durable0 = reg.counter("hvd_tpu_elastic_recoveries_total").value(
+            kind="durable")
+        seed = hvd.elastic.TPUState(
+            params={"w": jnp.full((3,), 5.0, jnp.float32)}, batch=0)
+        seed.batch = 11
+        seed.commit()
+        assert global_state().checkpoint_manager.wait_idle(30)
+
+        fresh = hvd.elastic.TPUState(
+            params={"w": jnp.zeros(3, jnp.float32)}, batch=0)
+        seen = {}
+
+        @hvd.elastic.run
+        def train(state):
+            seen["batch"] = state.batch
+            seen["w"] = np.asarray(state.params["w"]).copy()
+            return "done"
+
+        assert train(fresh) == "done"
+        assert seen["batch"] == 11
+        np.testing.assert_array_equal(seen["w"],
+                                      np.full((3,), 5.0, np.float32))
+        assert reg.counter("hvd_tpu_elastic_recoveries_total").value(
+            kind="durable") == durable0 + 1
+
+    def test_restore_timeline_in_trace_ring(self, tmp_path):
+        """The flight-recorder contract: snapshot writes and restores
+        record correlated ckpt.* spans into the PR 5 trace ring, so a
+        merged trace / flight dump shows the restore timeline."""
+        from horovod_tpu.trace import TraceRecorder, merge_segments
+        rec = TraceRecorder(rank=0, capacity=256)
+        rec.add_beacon(0.0, 1000.0, 0.001)
+        m = CheckpointManager(str(tmp_path), rank=0, world_size=1,
+                              trace=rec)
+        try:
+            m.snapshot(_tree(), step=3)
+            assert m.wait_idle(30)
+            m.restore_latest(template=_tree())
+        finally:
+            m.close(flush=False)
+        events = merge_segments({0: rec.segment()})
+        # spans are balanced B/E pairs, correlated via the corr id and
+        # carrying the CHECKPOINT kind
+        for nm in ("ckpt.write.g3", "ckpt.restore.g3"):
+            phs = [e["ph"] for e in events
+                   if str(e.get("args", {}).get("corr", ""))
+                   .startswith(nm + "#")]
+            assert phs.count("B") == 1 and phs.count("E") == 1, (nm,
+                                                                 events)
+        assert any(e.get("name") == "CHECKPOINT" for e in events)
+
+    def test_interval_hook_snapshots_provider(self, ckpt_world):
+        """HOROVOD_TPU_CHECKPOINT_INTERVAL_STEPS path: the engine's
+        step hook drives provider snapshots every k completed steps."""
+        from horovod_tpu.core.state import global_state
+        gs = global_state()
+        mgr = gs.checkpoint_manager
+        mgr.interval_steps = 2
+        tick = {"n": 0}
+
+        def provider():
+            tick["n"] += 1
+            return {"x": np.arange(3, dtype=np.float32)}, tick["n"]
+
+        mgr.register_provider(provider)
+        eng = gs.engine
+        for _ in range(4):
+            eng.step_begin()
+            eng.step_end()
+        assert mgr.wait_idle(30)
+        assert tick["n"] == 2  # steps 2 and 4
+        assert mgr.latest_generation() is not None

@@ -180,6 +180,16 @@ def _done_results(tmp_path):
     return results
 
 
+# Tier-1 budget (ISSUE 9 satellite, profiled with --durations=25): the
+# four real-process elastic runs cost ~380s together — far past the 870s
+# suite budget. test_elastic_scale_down stays in tier-1 as the
+# subsystem's multiprocess representative (it exercises world formation,
+# resize, AND clean worker removal in one 47s run, next to
+# test_multiprocess.py::test_run_elastic_programmatic); the hard-kill
+# recovery runs are real-process kills — the slow marker's own category
+# — and their recovery semantics run deterministically in tier-1 via the
+# chaos suite (watchdog hang -> restore -> finish, no process killed).
+@pytest.mark.slow
 @pytest.mark.integration
 def test_elastic_scale_up(tmp_path):
     """2 workers start; a third slot appears mid-run; all finish at size 3."""
@@ -220,6 +230,7 @@ def test_elastic_scale_down(tmp_path):
     assert len(removed) == 1, removed
 
 
+@pytest.mark.slow
 @pytest.mark.integration
 def test_elastic_crash_recovery(tmp_path):
     """A worker is hard-killed mid-run (no graceful exit). Survivors see the
@@ -247,6 +258,7 @@ def test_elastic_crash_recovery(tmp_path):
     assert sorted(r["rank"] for r in results) == [0, 1, 2]
 
 
+@pytest.mark.slow
 @pytest.mark.integration
 def test_elastic_crash_recovery_chained_optimizer(tmp_path):
     """Same hard-kill scenario, but the training loop is the r4

@@ -224,7 +224,53 @@ class TestPrometheusRender:
 # KVStoreServer GET /metrics round-trip
 # ---------------------------------------------------------------------------
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _isolated_registry():
+    """Swap the process-global registry for a fresh empty one.
+
+    The scrape endpoint merges the *server process's own* registry into
+    the response under rank="driver" — by design (elastic telemetry on
+    the launcher). In-process tests share one interpreter, so whatever
+    counters earlier test files left in the global registry (engine wire
+    bytes from test_stall/test_trace/test_chaos runs) would leak into
+    these exact-value assertions. This was a real ORDER DEPENDENCE:
+    TestScrapeEndpoint failed whenever registry-touching suites ran
+    first (reproduced at PR 7 HEAD with `pytest tests/test_stall.py
+    tests/test_trace.py tests/test_metrics.py::TestScrapeEndpoint`)."""
+    with hmetrics._registry_lock:
+        saved = hmetrics._registry
+        hmetrics._registry = Registry()
+    try:
+        yield
+    finally:
+        with hmetrics._registry_lock:
+            hmetrics._registry = saved
+
+
 class TestScrapeEndpoint:
+    @pytest.fixture(autouse=True)
+    def _fresh_registry(self):
+        with _isolated_registry():
+            yield
+
+    def test_scrape_isolated_from_polluted_process_registry(self):
+        """Regression for the order dependence itself, in the
+        non-alphabetical order: pollute the process registry the way an
+        earlier engine/stall/trace suite does, THEN run the round-trip
+        under the isolation this class now applies — the driver merge
+        must not leak the polluted series into the assertions."""
+        polluted = hmetrics.registry()   # the real global (fixture-swapped
+        # to a fresh one, so this test's pollution is itself contained)
+        polluted.counter("hvd_tpu_wire_bytes_total").inc(
+            320.0, kind="allreduce", dtype="float32")
+        polluted.counter("hvd_tpu_dispatches_total").inc(12)
+        with _isolated_registry():
+            self.test_kvstore_metrics_roundtrip()
+            self.test_metrics_scrape_empty_store()
+
     def test_kvstore_metrics_roundtrip(self):
         from horovod_tpu.runner.http_server import KVStoreServer
         server = KVStoreServer(("127.0.0.1", 0))

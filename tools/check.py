@@ -1,8 +1,8 @@
 """Unified static-analysis driver: every lint, one command, one report.
 
-Runs the five analysis passes the repo has accumulated (PRs 3-5 grew one
-script per namespace; ISSUE 7 consolidates them and adds the concurrency
-lints):
+Runs the six analysis passes the repo has accumulated (PRs 3-5 grew one
+script per namespace; ISSUE 7 consolidated them and added the
+concurrency lints; ISSUE 9 added the checkpoint-manifest contract):
 
 - ``lockcheck``     — GUARDED_BY lock-discipline checker over
                       ``horovod_tpu/`` (horovod_tpu.analysis.lockcheck)
@@ -16,6 +16,11 @@ lints):
                       2-rank merged trace must pass
                       ``tools/trace_report.py --check``'s ``check_events``
                       and a deliberately-broken event list must fail it
+- ``ckpt_manifest`` — checkpoint-manifest contract self-check: a live
+                      round-tripped 2-rank generation must validate and
+                      the commit barrier must reject mismatched
+                      checksums / stale world_versions / partial
+                      generations (horovod_tpu.checkpoint.manifest)
 
 Usage (from the repo root)::
 
@@ -122,12 +127,78 @@ def run_trace_schema() -> Tuple[List[str], dict]:
                     "violation_classes_proven": 3}
 
 
+def run_ckpt_manifest() -> Tuple[List[str], dict]:
+    """Checkpoint-manifest contract self-check (ISSUE 9): a LIVE
+    generation written by two CheckpointManager ranks must round-trip
+    through the schema validator and the commit barrier — and the
+    barrier must still reject each known violation class (mismatched
+    shard checksum, stale world_version, missing rank), so a green run
+    can't mean a gutted validator."""
+    import copy
+    import json as _json
+    import tempfile
+
+    import numpy as np
+
+    from horovod_tpu.checkpoint import (CheckpointManager,
+                                        generation_complete,
+                                        validate_manifest)
+    errors: List[str] = []
+    tree = {"w": np.arange(40, dtype=np.float32),
+            "b": np.ones((3,), np.float32)}
+    with tempfile.TemporaryDirectory() as d:
+        mgrs = [CheckpointManager(d, rank=r, world_size=2, redundancy=1)
+                for r in range(2)]
+        try:
+            for m in mgrs:
+                m.snapshot(tree, step=1)
+            for m in mgrs:
+                if not m.wait_idle(60):
+                    errors.append("checkpoint write did not finish")
+            manifests = mgrs[0]._disk_manifests(1)
+        finally:
+            for m in mgrs:
+                m.close(flush=False)
+    if sorted(manifests) != [0, 1]:
+        return errors + [f"round-trip produced manifests for ranks "
+                         f"{sorted(manifests)}, expected [0, 1]"], {}
+    for r, m in manifests.items():
+        # re-parse through JSON: the validator must accept exactly what
+        # lands on disk/KV, not the in-memory dict
+        for e in validate_manifest(_json.loads(_json.dumps(m))):
+            errors.append(f"live manifest rank {r} failed schema: {e}")
+    ok, errs = generation_complete(manifests)
+    if not ok:
+        errors += [f"live generation failed the commit barrier: {e}"
+                   for e in errs]
+    # violation class 1: corrupted shard checksum
+    bad = copy.deepcopy(manifests)
+    bad[1]["shard_checksums"]["1"] = "0" * 64
+    ok, errs = generation_complete(bad)
+    if ok or not any("checksum mismatch" in e for e in errs):
+        errors.append("barrier no longer rejects a mismatched shard "
+                      "checksum")
+    # violation class 2: stale world_version (generation spans a reset)
+    bad = copy.deepcopy(manifests)
+    bad[1]["world_version"] += 1
+    ok, errs = generation_complete(bad)
+    if ok or not any("stale world_version" in e for e in errs):
+        errors.append("barrier no longer rejects a stale world_version")
+    # violation class 3: partial generation (a rank never committed)
+    ok, errs = generation_complete({0: manifests[0]})
+    if ok or not any("missing manifests" in e for e in errs):
+        errors.append("barrier no longer rejects a partial generation")
+    return errors, {"manifests": len(manifests),
+                    "violation_classes_proven": 3}
+
+
 CHECKS: Dict[str, Callable[[], Tuple[List[str], dict]]] = {
     "lockcheck": run_lockcheck,
     "knobs": run_knobs,
     "metrics": run_metrics,
     "faults": run_faults,
     "trace_schema": run_trace_schema,
+    "ckpt_manifest": run_ckpt_manifest,
 }
 
 

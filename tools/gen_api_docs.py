@@ -58,6 +58,10 @@ SECTIONS = [
         "DuplicateNameError"]),
     ("Elastic training", "horovod_tpu.elastic", [
         "run", "State", "ObjectState", "TPUState"]),
+    ("Checkpointing", "horovod_tpu.checkpoint", [
+        "CheckpointManager", "RestoreResult", "CheckpointRestoreError",
+        "build_manifest", "validate_manifest", "generation_complete",
+        "checksum", "reshard_ranges", "zero1_reshard"]),
     ("Cluster run API", "horovod_tpu.runner", [
         "run", "run_elastic"]),
     ("Estimator & store", "horovod_tpu", []),
