@@ -593,6 +593,126 @@ def bench_pipeline_bubble():
     return _run_forced_cpu(_PIPELINE_BUBBLE_PAYLOAD, 4)
 
 
+def _size_label(nbytes: int) -> str:
+    if nbytes >= 1024 ** 2:
+        return f"{nbytes // 1024 ** 2}MB"
+    return f"{nbytes // 1024}KB"
+
+
+def bench_busbw(sizes_bytes=None, kinds=("allreduce", "allgather"),
+                iters=8):
+    """Bus-bandwidth message-size sweep vs the topology roofline
+    (ISSUE 10 acceptance surface).
+
+    For every (kind, size band): ``choose_algorithm`` picks the lowering
+    for the live topology (the same selection the engine applies per
+    fusion bucket), the corresponding grouped builder runs a
+    single-bucket program of that size over every device, and achieved
+    **bus bandwidth** is reported next to the nominal roofline
+    (``Topology.roofline_busbw_gbps``). busbw follows the nccl-tests
+    convention — algbw scaled by the algorithm-independent data-movement
+    factor (2(n-1)/n for allreduce, (n-1)/n for allgather) — so flat,
+    tree, and hierarchical lowerings land on one comparable axis.
+
+    Emitted fields: ``busbw_<kind>_<size>`` (GB/s),
+    ``busbw_roofline_<kind>_<size>``, per-band spread, and
+    ``collective_algo_selected`` mapping each band to its chosen
+    algorithm. Timing uses the PR 6 noise-escalation pattern (doubling
+    iteration spans, cap 2 escalations, keep the quietest reading).
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from horovod_tpu.common.env import Config
+    from horovod_tpu.common.reduce_ops import ReduceOp
+    from horovod_tpu.ops import collectives as C
+    from horovod_tpu.parallel.mesh import detect_topology
+
+    devs = jax.devices()
+    n = len(devs)
+    topo = detect_topology(devices=devs)
+    cfg = Config.from_env()
+    out = {"busbw_world": n, "busbw_topology": topo.describe()}
+    if n <= 1:
+        out["busbw_note"] = ("single device: collectives are no-ops, "
+                             "sweep skipped")
+        out["collective_algo_selected"] = {}
+        return out
+    mesh = Mesh(np.array(devs), ("world",))
+    sh = NamedSharding(mesh, P("world"))
+    if sizes_bytes is None:
+        sizes_bytes = [64 * 1024, 1024 ** 2, 8 * 1024 ** 2, 32 * 1024 ** 2]
+
+    def measure(run, its):
+        def span(k):
+            t0 = time.perf_counter()
+            last = None
+            for _ in range(k):
+                last = run()
+            jax.block_until_ready(last)
+            return (time.perf_counter() - t0) / k
+        best = None
+        escalations = 0
+        while True:
+            samples = sorted(span(its) for _ in range(3))
+            med = samples[1]
+            spread = 100.0 * (samples[-1] - samples[0]) / max(med, 1e-12)
+            if best is None or spread < best[1]:
+                best = (med, spread)
+            if spread <= 10.0 or escalations >= 2:
+                return best[0], best[1], escalations
+            its *= 2
+            escalations += 1
+
+    selected = {}
+    total_escalations = 0
+    for kind in kinds:
+        for size in sizes_bytes:
+            label = _size_label(size)
+            band = f"{kind}_{label}"
+            algo = C.choose_algorithm(
+                kind, size, topo, force=cfg.collective_algo,
+                tree_threshold_bytes=cfg.tree_threshold_bytes)
+            selected[band] = algo
+            elems = max(size // 4, n)  # float32
+            rng = np.random.RandomState(0)
+            if kind == "allreduce":
+                # stacked single-bucket grouped program: (n, elems) in,
+                # moved bytes factor 2(n-1)/n of the per-rank payload
+                fn = C.build_grouped_allreduce(
+                    mesh, "world", ReduceOp.SUM, ((elems,),),
+                    [jnp.float32], [[0]],
+                    local_size=topo.local_size, algos=(algo,))
+                arg = jax.device_put(
+                    jnp.asarray(rng.rand(n, elems).astype(np.float32)), sh)
+                run = lambda fn=fn, arg=arg: fn(arg)[0]
+                factor = 2.0 * (n - 1) / n
+                payload = elems * 4
+            else:  # allgather: per-rank shard in, full buffer out
+                _, shard = C.shard_spec(elems, n)
+                fn = C.build_grouped_allgather(
+                    mesh, "world", ((elems,),), [jnp.float32], [[0]],
+                    local_size=topo.local_size, algos=(algo,))
+                arg = jax.device_put(
+                    jnp.asarray(rng.rand(n, shard).astype(np.float32)), sh)
+                run = lambda fn=fn, arg=arg: fn(arg)[0]
+                factor = (n - 1) / n
+                payload = elems * 4
+            run()  # compile outside the timed span
+            dt, spread, esc = measure(run, iters)
+            total_escalations += esc
+            busbw = factor * payload / dt / 1e9
+            out[f"busbw_{band}"] = round(busbw, 3)
+            out[f"busbw_{band}_spread_pct"] = round(spread, 1)
+            out[f"busbw_roofline_{band}"] = round(
+                topo.roofline_busbw_gbps(kind, algo), 3)
+    out["collective_algo_selected"] = selected
+    out["busbw_escalations"] = total_escalations
+    out["busbw_timing"] = f"median_of_3_spans_x{iters}_iters"
+    return out
+
+
 def bench_sp_ring():
     """Sequence-parallel ring attention MFU at T=8192, three readings:
 
@@ -1123,6 +1243,13 @@ def main():
         sp = {"sp_ring_error": f"{type(e).__name__}: {e}"}
     lm.update(sp)
 
+    # topology-aware collective selection: bus-bandwidth sweep vs the
+    # roofline + the algorithm chosen per size band (ISSUE 10)
+    try:
+        busbw = bench_busbw()
+    except Exception as e:
+        busbw = {"busbw_error": f"{type(e).__name__}: {e}"}
+
     print(json.dumps({
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
         "value": round(img_s_chip, 2),
@@ -1148,6 +1275,7 @@ def main():
         "pipeline_bubble_pct": bubble.get("pipeline_bubble_pct"),
         "pipeline_bubble_detail": bubble,
         **ckpt,
+        **busbw,
         "spmd_spread_pct": round(spmd_spread, 1),
         "achieved_tflops_per_chip": round(tflops_chip, 2),
         "mfu_pct": (round(100.0 * tflops_chip / peak, 2)

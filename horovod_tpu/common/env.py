@@ -144,6 +144,22 @@ HOROVOD_TPU_COLLECTIVE_DEADLINE = "HOROVOD_TPU_COLLECTIVE_DEADLINE"
 # strike); slots past HOROVOD_ELASTIC_SLOT_FAILURE_LIMIT are out for good
 HOROVOD_ELASTIC_FAILURE_BACKOFF = "HOROVOD_ELASTIC_FAILURE_BACKOFF"
 HOROVOD_ELASTIC_SLOT_FAILURE_LIMIT = "HOROVOD_ELASTIC_SLOT_FAILURE_LIMIT"
+# topology-aware collective algorithm selection (ISSUE 10): which lowering
+# every reduction/gather bucket gets. "auto" (default) picks per
+# (bytes, topology) — tree (recursive doubling) for latency-bound small
+# buckets on power-of-2 worlds, the hierarchical ICI/DCN ladder when the
+# topology has a non-trivial slice decomposition, flat ring otherwise;
+# "flat"/"tree"/"hierarchical" force one lowering everywhere (invalid
+# forcings demote to flat with a one-time WARNING, never a crash). Also an
+# autotune categorical ("collective_algo": env-resolved base vs flat).
+HOROVOD_TPU_COLLECTIVE_ALGO = "HOROVOD_TPU_COLLECTIVE_ALGO"
+# topology override (parallel/mesh.detect_topology): ranks per fast-fabric
+# island (ICI slice / host) when the device-attribute probe cannot see the
+# real fabric; takes precedence over launcher-derived local sizes
+HOROVOD_TPU_LOCAL_SIZE = "HOROVOD_TPU_LOCAL_SIZE"
+# auto mode lowers a reduction bucket to the tree form when its payload is
+# at most this many bytes (latency-bound regime; ring bandwidth wins above)
+HOROVOD_TPU_TREE_THRESHOLD_BYTES = "HOROVOD_TPU_TREE_THRESHOLD_BYTES"
 # async sharded checkpointing (ISSUE 9, horovod_tpu/checkpoint/): setting
 # the directory enables the durable tier — TPUState commits snapshot
 # through the CheckpointManager and elastic recovery falls back to the
@@ -160,6 +176,8 @@ DEFAULT_CACHE_CAPACITY = 1024                      # operations.cc:449-456
 DEFAULT_STALL_WARNING_SECONDS = 60.0               # stall_inspector.h:75
 DEFAULT_OVERLAP_STAGE_BYTES = 8 * 1024 * 1024
 OVERLAP_PIPELINE_MODES = ("auto", "off", "interleave", "staged")
+DEFAULT_TREE_THRESHOLD_BYTES = 256 * 1024
+COLLECTIVE_ALGO_MODES = ("auto", "flat", "tree", "hierarchical")
 _XLA_LHS_FLAG = "--xla_tpu_enable_latency_hiding_scheduler=true"
 
 
@@ -296,6 +314,8 @@ class Config:
     overlap_pipeline: str = "auto"
     overlap_stage_bytes: int = DEFAULT_OVERLAP_STAGE_BYTES
     zero1_prefetch: bool = True
+    collective_algo: str = "auto"
+    tree_threshold_bytes: int = DEFAULT_TREE_THRESHOLD_BYTES
     # NOTE: the HOROVOD_TPU_METRICS on/off switch is read by
     # metrics.metrics_enabled() (the registry outlives any Config); only
     # the emitter knobs live here
@@ -352,6 +372,11 @@ class Config:
             overlap_stage_bytes=_get_int(HOROVOD_TPU_OVERLAP_STAGE_BYTES,
                                          DEFAULT_OVERLAP_STAGE_BYTES),
             zero1_prefetch=_get_bool(HOROVOD_TPU_ZERO1_PREFETCH, True),
+            collective_algo=_get_choice(
+                HOROVOD_TPU_COLLECTIVE_ALGO, "auto", COLLECTIVE_ALGO_MODES),
+            tree_threshold_bytes=_get_int(
+                HOROVOD_TPU_TREE_THRESHOLD_BYTES,
+                DEFAULT_TREE_THRESHOLD_BYTES),
             metrics_file=os.environ.get(HOROVOD_TPU_METRICS_FILE) or None,
             metrics_interval=_get_float(HOROVOD_TPU_METRICS_INTERVAL, 10.0),
             trace_enabled=_get_bool(HOROVOD_TPU_TRACE, True),

@@ -106,6 +106,27 @@ KNOB_SPECS: Dict[str, dict] = {
         "type": "bool", "default": "0",
         "help": "Append --xla_tpu_enable_latency_hiding_scheduler=true "
                 "to XLA_FLAGS before the first backend touch."},
+    # -- topology-aware collective algorithm selection (ISSUE 10) -----------
+    "HOROVOD_TPU_COLLECTIVE_ALGO": {
+        "type": "choice", "default": "auto",
+        "choices": ("auto", "flat", "tree", "hierarchical"),
+        "help": "Collective lowering per reduction/gather bucket: auto "
+                "picks flat-ring vs tree (recursive doubling, small "
+                "latency-bound buckets) vs hierarchical (intra-slice RS "
+                "over ICI, 1/local_size cross-slice exchange over DCN, "
+                "AG back) per (bytes, topology); forced values demote to "
+                "flat with a one-time WARNING when invalid."},
+    "HOROVOD_TPU_LOCAL_SIZE": {
+        "type": "int", "default": "derived",
+        "help": "Topology override: ranks per fast-fabric island "
+                "(ICI slice / host) when the device-attribute probe "
+                "cannot see the real fabric; wins over launcher-derived "
+                "local sizes."},
+    "HOROVOD_TPU_TREE_THRESHOLD_BYTES": {
+        "type": "int", "default": str(256 * 1024),
+        "help": "Auto algorithm selection lowers a reduction bucket to "
+                "the tree form when its payload is at most this many "
+                "bytes."},
     # -- ZeRO-1 sharded optimizer -------------------------------------------
     "HOROVOD_TPU_SHARD_OPTIMIZER": {
         "type": "bool", "default": "0",

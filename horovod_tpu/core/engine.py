@@ -39,7 +39,7 @@ from ..common.lru import lru_get, lru_put, lru_touch
 from ..common.reduce_ops import ReduceOp
 from ..metrics import registry as metrics_registry
 from ..ops import collectives as C
-from ..parallel.mesh import WORLD_AXIS
+from ..parallel.mesh import WORLD_AXIS, detect_topology
 from .backend import Backend
 
 
@@ -382,6 +382,24 @@ class Engine:
         self._overlap_base = (config.overlap_pipeline
                               if config.overlap_pipeline != "off"
                               else "auto")
+        # Topology-aware collective algorithm selection (ISSUE 10): the
+        # fabric descriptor is resolved ONCE per engine (an elastic reset
+        # builds a fresh engine, so a resized world re-detects) and
+        # threaded to every builder through _choose_algo. The autotune
+        # categorical toggles the env-resolved base vs flat, the
+        # overlap_pipeline pattern. The group mesh holds exactly one
+        # device per RANK, so probing its slice_index attributes yields
+        # ranks-per-slice (the engine's unit) — probing all local chips
+        # would conflate devices-per-slice with ranks-per-slice on
+        # multi-chip-per-process worlds.
+        group_devs = (list(backend.group_mesh.devices.flat)
+                      if backend.group_mesh is not None else None)
+        self.topology = detect_topology(size=backend.size(),
+                                        local_size=backend.local_size(),
+                                        devices=group_devs)
+        self._algo_base = (config.collective_algo
+                           if config.collective_algo != "flat" else "auto")
+        self._m_algo = _reg.counter("hvd_tpu_collective_algo_total")
         self._zero1_prefetch: Dict[tuple, dict] = {}
         self._in_step_bracket = False
         self._overlap_step_noted = False
@@ -421,6 +439,17 @@ class Engine:
         # this error instead of hanging behind the wedged collective —
         # the engine is unusable until the elastic reset rebuilds it.
         self._poison: Optional[Exception] = None
+        # Resolve the hierarchical-homogeneity agreement EAGERLY, here at
+        # init — a collectively-synchronized point every rank reaches
+        # before any collective or join() can start. Resolving it lazily
+        # at the first selection collided with the Join protocol (the
+        # active rank's agreement exchange has no advertisement a joined
+        # peer could match), and gating entry on the rank-local topology
+        # view would deadlock heterogeneous worlds; one tiny exchange per
+        # engine lifetime buys a pure cached read on every later
+        # selection.
+        if backend.size() > 1:
+            self._hierarchical_ok()
         # Cycle loop: the analog of RunLoopOnce (operations.cc:566-616) — wakes
         # every cycle_time_ms to retire completed handles so fire-and-forget
         # async ops clear the outstanding table without user poll/synchronize.
@@ -485,16 +514,117 @@ class Engine:
         self.dispatch_count += 1
         self._m_dispatches.inc()
 
-    def _m_account(self, kind: str, tensors):
+    # -- topology-aware collective algorithm selection (ISSUE 10) ----------
+
+    def _choose_algo(self, kind: str, nbytes: int) -> str:
+        """The per-bucket algorithm for one collective of ``kind`` moving
+        ``nbytes``: the engine face of ops.collectives.choose_algorithm,
+        with two engine-only concerns layered on top — the legacy
+        hierarchy env knobs act as a forced preference for their kind,
+        and any hierarchical outcome (auto or forced) additionally
+        requires the collectively-agreed homogeneity check
+        (_hierarchical_ok), because a rank-local topology read can
+        diverge on heterogeneous host assignments and selection MUST be
+        identical on every rank (the programs must match)."""
+        topo = self.topology
+        if topo.size <= 1:
+            return C.ALGO_FLAT
+        # The homogeneity agreement is resolved at engine init (see
+        # __init__) so this is a cached read on every path, and it is
+        # consulted REGARDLESS of the rank-local topology view: gating
+        # the agreement on topo.hierarchical_ok would let heterogeneous
+        # worlds diverge (ranks whose local view factorizes entering an
+        # exchange flat-view ranks skip — a deadlock). A heterogeneous
+        # world uniformly agrees on "no hierarchy".
+        hier_ok = self._hierarchical_ok()
+        force = self.config.collective_algo
+        if force != "auto":
+            algo = C.validate_algorithm(kind, force, topo.size,
+                                        topo.local_size)
+        elif kind == "allreduce" and self.config.hierarchical_allreduce \
+                and hier_ok:
+            algo = C.ALGO_HIERARCHICAL
+        elif kind == "allgather" and self.config.hierarchical_allgather \
+                and hier_ok:
+            algo = C.ALGO_HIERARCHICAL
+        else:
+            algo = C.choose_algorithm(
+                kind, nbytes, topo,
+                tree_threshold_bytes=self.config.tree_threshold_bytes)
+        if algo == C.ALGO_HIERARCHICAL and not hier_ok:
+            return C.ALGO_FLAT
+        return algo
+
+    def _bucket_algos(self, kind: str, tensors, buckets,
+                      count: bool = True) -> tuple:
+        """Per-fusion-bucket algorithm selection for one grouped call
+        (each bucket is its own (bytes, topology) decision — a step's
+        small latency-bound bucket can lower to tree while its big
+        bucket takes the hierarchical ladder). ``count=True`` records
+        the selections in hvd_tpu_collective_algo_total — pass False on
+        re-derivations of the same call's choice."""
+        algos = tuple(
+            self._choose_algo(kind, sum(tensors[i].nbytes for i in idxs))
+            for idxs in buckets)
+        if count and self._m_enabled:
+            for a in algos:
+                self._m_algo.inc(kind=kind, algo=a)
+        return algos
+
+    def _algo_sig(self) -> tuple:
+        """Knob state the algorithm selection depends on — compared to
+        detect a mid-call (autotune sample boundary) flip and by replay
+        to re-arm on any move."""
+        cfg = self.config
+        return (cfg.collective_algo, cfg.tree_threshold_bytes,
+                cfg.hierarchical_allreduce, cfg.hierarchical_allgather)
+
+    def _tensor_links(self, kind: str, tensors, buckets=None, algos=None):
+        """Per-tensor link-byte split for wire accounting and trace
+        stamping: each tensor inherits its fusion bucket's algorithm.
+        ``buckets=None`` derives the live bucketing (the same rule the
+        dispatch path applies). Returns a list of {link: bytes} dicts
+        aligned with ``tensors``, or None when nobody would consume them
+        (size <= 1, or metrics AND tracing both off — the link
+        derivation must cost nothing on a fully-quiet hot path)."""
+        if self.topology.size <= 1 or not tensors:
+            return None
+        if not self._m_enabled and self.trace is None:
+            return None
+        if buckets is None:
+            buckets = bucket_by_size(tensors,
+                                     self.config.fusion_threshold_bytes)
+        if algos is None:
+            algos = self._bucket_algos(kind, tensors, buckets)
+        local = self.topology.local_size
+        links = [None] * len(tensors)
+        for idxs, algo in zip(buckets, algos):
+            for i in idxs:
+                links[i] = C.link_split(algo, tensors[i].nbytes, local,
+                                        kind=kind)
+        return links
+
+    def _m_account(self, kind: str, tensors, links=None):
         """Wire-byte accounting at collective submission: payload bytes this
-        rank hands to the collective, split by op kind and dtype (the
-        reference's TensorQueue size accounting, made scrapeable). Counted
-        before replay interception — a replayed step moves the same bytes."""
+        rank hands to the collective, split by op kind, dtype, and fabric
+        link (the reference's TensorQueue size accounting, made
+        scrapeable). Counted before replay interception — a replayed step
+        moves the same bytes. ``links`` (from :meth:`_tensor_links`)
+        splits hierarchical buckets into their ICI and DCN legs; without
+        it every byte rides link="flat" (whole-fabric)."""
         if not self._m_enabled:
             return
         self._m_collectives.inc(1.0, kind=kind)
-        for t in tensors:
-            self._m_wire.inc(t.nbytes, kind=kind, dtype=str(t.dtype))
+        for i, t in enumerate(tensors):
+            split = links[i] if links else None
+            if split:
+                for link, b in split.items():
+                    if b:
+                        self._m_wire.inc(b, kind=kind, dtype=str(t.dtype),
+                                         link=link)
+            else:
+                self._m_wire.inc(t.nbytes, kind=kind, dtype=str(t.dtype),
+                                 link="flat")
 
     def _m_buckets_obs(self, tensors, buckets):
         """Fusion-bucket fill efficiency for one grouped/sharded call."""
@@ -509,7 +639,8 @@ class Engine:
         thr = max(self.config.fusion_threshold_bytes, 1)
         self._m_fill.set(100.0 * total / (len(buckets) * thr))
 
-    def _register(self, name: Optional[str], kind: str, nbytes: int) -> str:
+    def _register(self, name: Optional[str], kind: str, nbytes: int,
+                  link_bytes: Optional[dict] = None) -> str:
         # every collective submission funnels through here — the canonical
         # failpoint for "this rank's op never starts"
         failpoint("engine.enqueue")
@@ -528,7 +659,8 @@ class Engine:
         if self.trace is not None:
             # stamp the correlation id BEFORE the on_enqueue hook so the
             # timeline closure can tag its span with trace.live_corr(name)
-            self.trace.record_enqueue(name, kind, nbytes, self.world_version)
+            self.trace.record_enqueue(name, kind, nbytes, self.world_version,
+                                      link_bytes=link_bytes)
         if self.on_enqueue is not None:
             self.on_enqueue(name, kind, nbytes)
         return name
@@ -722,6 +854,13 @@ class Engine:
             self.config.overlap_pipeline = (
                 self._overlap_base
                 if pm.categorical_value("overlap_pipeline") else "off")
+        # collective_algo is the same boolean-over-string pattern: the
+        # categorical explores topology-aware selection (the env-resolved
+        # base — auto or a forced algorithm) vs the flat ring everywhere
+        if pm.tunes("collective_algo"):
+            self.config.collective_algo = (
+                self._algo_base
+                if pm.categorical_value("collective_algo") else "flat")
 
     def _dispatch(self, names, fn, *args):
         """Dispatch with failure translation + a timeline ACTIVITY span per
@@ -1061,7 +1200,7 @@ class Engine:
         caller allgathers local_size and requires uniformity."""
         if self._hier_ok is not None:
             return self._hier_ok
-        local = self.backend.local_size()
+        local = self.topology.local_size
         size = self.backend.size()
         if size == 1:
             self._hier_ok = False
@@ -1072,20 +1211,26 @@ class Engine:
         return self._hier_ok
 
     def _allreduce_builder(self, op: ReduceOp, prescale_factor: float,
-                           postscale_factor: float):
-        """Flat vs hierarchical allreduce dispatch (the role of
-        OperationManager priority selection, operations.cc:142-249):
-        hierarchical kicks in when HOROVOD_HIERARCHICAL_ALLREDUCE is set and
-        the (homogeneous) topology has a non-trivial (cross, local)
-        factorization."""
+                           postscale_factor: float,
+                           algo: str = C.ALGO_FLAT):
+        """Flat vs tree vs hierarchical allreduce dispatch (the role of
+        OperationManager priority selection, operations.cc:142-249), per
+        the topology-aware choice the caller resolved with
+        :meth:`_choose_algo`."""
         mesh = self.backend.group_mesh
-        local = self.backend.local_size()
-        if self.config.hierarchical_allreduce and self._hierarchical_ok():
+        local = self.topology.local_size
+        if algo == C.ALGO_HIERARCHICAL:
             return self._builder(
                 ("hier_allreduce", op, local, prescale_factor,
                  postscale_factor),
                 lambda: C.build_hierarchical_allreduce(
                     mesh, self._axis(), local, op, prescale_factor,
+                    postscale_factor))
+        if algo == C.ALGO_TREE:
+            return self._builder(
+                ("tree_allreduce", op, prescale_factor, postscale_factor),
+                lambda: C.build_tree_allreduce(
+                    mesh, self._axis(), op, prescale_factor,
                     postscale_factor))
         return self._builder(
             ("allreduce", op, prescale_factor, postscale_factor),
@@ -1101,17 +1246,27 @@ class Engine:
         x = jnp.asarray(tensor)
         sub = self._consume_substitute()
         _check_average_dtype(x, op)
-        self._m_account("allreduce", [x])
+        algo, links = C.ALGO_FLAT, None
+        if self.topology.size > 1:
+            algo = self._choose_algo("allreduce", x.nbytes)
+            if self._m_enabled:
+                self._m_algo.inc(kind="allreduce", algo=algo)
+            if self._m_enabled or self.trace is not None:
+                links = [C.link_split(algo, x.nbytes,
+                                      self.topology.local_size)]
+        self._m_account("allreduce", [x], links)
         r = self._replay.intercept("allreduce", [x], int(op),
                                    prescale_factor, postscale_factor, name,
                                    sub)
         if r is not None:
             return r[0]
-        name = self._register(name, "allreduce", x.nbytes)
+        name = self._register(name, "allreduce", x.nbytes,
+                              link_bytes=links[0] if links else None)
         self._join_sync("allreduce", [_join_meta_row(x, int(op))], skip=sub)
         self._debug_check(name, "allreduce", [x], op_code=int(op),
                           wildcard=sub)
-        fn = self._allreduce_builder(op, prescale_factor, postscale_factor)
+        fn = self._allreduce_builder(op, prescale_factor, postscale_factor,
+                                     algo)
         out = self._dispatch(name, lambda: fn(self.backend.to_global(x)))
         return self._single(name, out, kind="allreduce")
 
@@ -1126,8 +1281,21 @@ class Engine:
         sub = self._consume_substitute()
         for t in tensors:
             _check_average_dtype(t, op)
+        links = None
+        derived = None   # (threshold, buckets, algos) for dispatch reuse
         if tensors:
-            self._m_account("grouped_allreduce", tensors)
+            # selection + link attribution ride the live bucketing; wire
+            # accounting stays BEFORE replay interception so replayed
+            # steps keep counting the bytes they move. The derivation is
+            # kept for the dispatch path below — recomputed only if
+            # _pm_step retunes the fusion threshold mid-call.
+            if self.topology.size > 1:
+                thr0 = self.config.fusion_threshold_bytes
+                b0 = bucket_by_size(tensors, thr0)
+                a0 = self._bucket_algos("allreduce", tensors, b0)
+                links = self._tensor_links("allreduce", tensors, b0, a0)
+                derived = (thr0, self._algo_sig(), b0, a0)
+            self._m_account("grouped_allreduce", tensors, links)
             r = self._replay.intercept("grouped_allreduce", tensors, int(op),
                                        prescale_factor, postscale_factor,
                                        name, sub)
@@ -1138,18 +1306,29 @@ class Engine:
                         skip=sub)
         self._pm_step(sum(t.nbytes for t in tensors))
         names = [self._register(None if name is None else f"{name}.{i}",
-                                "grouped_allreduce", t.nbytes)
+                                "grouped_allreduce", t.nbytes,
+                                link_bytes=links[i] if links else None)
                  for i, t in enumerate(tensors)]
         self._debug_check(names[0] if names else "empty", "grouped_allreduce",
                           tensors, op_code=int(op), wildcard=sub)
         if not tensors:
             return []
-        buckets = bucket_by_size(tensors, self.config.fusion_threshold_bytes)
+        if derived is not None \
+                and derived[0] == self.config.fusion_threshold_bytes \
+                and derived[1] == self._algo_sig():
+            buckets, algos = derived[2], derived[3]
+        else:
+            # _pm_step retuned a selection knob mid-call (or size-1
+            # world): re-derive so THIS call's buckets and algorithms
+            # track the live knobs (selection was already counted at
+            # accounting time)
+            buckets = bucket_by_size(tensors,
+                                     self.config.fusion_threshold_bytes)
+            algos = self._bucket_algos("allreduce", tensors, buckets,
+                                       count=False)
         self._m_buckets_obs(tensors, buckets)
         mesh = self.backend.group_mesh
-        hier_local = (self.backend.local_size()
-                      if (self.config.hierarchical_allreduce and
-                          self._hierarchical_ok()) else 0)
+        hier_local = self.topology.local_size
         from ..ops.pallas_kernels import pack_pallas, pack_pallas_enabled
         pm = self.parameter_manager
         use_pallas_pack = (pm.categorical_value("pallas_pack")
@@ -1182,12 +1361,13 @@ class Engine:
             packed = _translate_failure(pack_fn, *tensors)
             fn = self._builder(
                 ("grouped_allreduce", op, prescale_factor,
-                 postscale_factor, shapes, dtypes, bkey, hier_local, pipe),
+                 postscale_factor, shapes, dtypes, bkey, hier_local, pipe,
+                 algos),
                 lambda: C.build_grouped_allreduce(
                     mesh, self._axis(), op, shapes,
                     [t.dtype for t in tensors], buckets,
                     prescale_factor, postscale_factor, hier_local,
-                    pipeline=pipe))
+                    pipeline=pipe, algos=algos))
             outs = self._dispatch(
                 names,
                 lambda: fn(*[self.backend.to_global(p, batched=True)
@@ -1199,10 +1379,11 @@ class Engine:
             # Per-bucket two-dispatch form (pack, then reduce+unpack) —
             # kept for the Pallas pack kernel, whose packing is its own
             # launch (autotune's pallas_pack categorical flips this).
-            for idxs in buckets:
+            for b, idxs in enumerate(buckets):
                 bucket = [tensors[i] for i in idxs]
                 shapes = tuple(tuple(t.shape) for t in bucket)
                 dtype = bucket[0].dtype
+                algo = algos[b]
                 self._count_dispatch()
                 if use_pallas_pack:
                     packed = _translate_failure(pack_pallas, bucket)
@@ -1213,10 +1394,12 @@ class Engine:
                     packed = _translate_failure(pack_fn, *bucket)
                 fn = self._builder(
                     ("fused_allreduce", op, prescale_factor,
-                     postscale_factor, shapes, str(dtype), hier_local),
+                     postscale_factor, shapes, str(dtype), hier_local,
+                     algo),
                     lambda: C.build_fused_allreduce(
                         mesh, self._axis(), op, shapes, dtype,
-                        prescale_factor, postscale_factor, hier_local))
+                        prescale_factor, postscale_factor, hier_local,
+                        algo=algo))
                 outs = self._dispatch(
                     [names[i] for i in idxs],
                     lambda: fn(self.backend.to_global(packed)))
@@ -1278,15 +1461,28 @@ class Engine:
             buckets = bucket_by_size(tensors,
                                      self.config.fusion_threshold_bytes)
         bkey = tuple(tuple(b) for b in buckets)
+        # topology-aware leg selection (ISSUE 10): the reduce-scatter leg
+        # is pinned flat (shard-ownership invariant, ops/collectives.py
+        # validate_algorithm), the return all-gather picks flat vs the
+        # hierarchical two-level gather per bucket
+        ag_algos = self._bucket_algos("allgather", tensors, buckets)
+        ag_links = self._tensor_links("allgather", tensors, buckets,
+                                      ag_algos)
         # wire accounting: a sharded step moves each gradient bucket once
         # as a reduce-scatter and once back as the parameter all-gather
         if self._m_enabled:
             self._m_collectives.inc(1.0, kind="sharded_step")
-            for t in tensors:
+            for _ in buckets:
+                self._m_algo.inc(kind="reducescatter", algo=C.ALGO_FLAT)
+            for i, t in enumerate(tensors):
                 self._m_wire.inc(t.nbytes, kind="reducescatter",
-                                 dtype=str(t.dtype))
-                self._m_wire.inc(t.nbytes, kind="allgather",
-                                 dtype=str(t.dtype))
+                                 dtype=str(t.dtype), link="flat")
+                split = (ag_links[i] if ag_links
+                         else {"flat": t.nbytes})
+                for link, b in split.items():
+                    if b:
+                        self._m_wire.inc(b, kind="allgather",
+                                         dtype=str(t.dtype), link=link)
         self._m_buckets_obs(tensors, buckets)
         # register BEFORE replay interception: a replayed launch resolves
         # the update closure from this registry at trace time. LRU-bounded
@@ -1305,8 +1501,20 @@ class Engine:
                         [_join_meta_row(t, int(op)) for t in tensors],
                         skip=sub)
         self._pm_step(sum(t.nbytes for t in tensors))
+        def _sharded_link_bytes(i, t):
+            # a sharded tensor moves once over the flat rs ring and once
+            # back over the (possibly hierarchical) ag leg
+            if i >= len(tensors):
+                return None
+            merged = {"flat": int(t.nbytes)}
+            for link, b in (ag_links[i] if ag_links
+                            else {"flat": int(t.nbytes)}).items():
+                merged[link] = merged.get(link, 0) + int(b)
+            return merged
+
         names = [self._register(None if name is None else f"{name}.{i}",
-                                "sharded_step", t.nbytes)
+                                "sharded_step", t.nbytes,
+                                link_bytes=_sharded_link_bytes(i, t))
                  for i, t in enumerate(all_ts)]
         self._debug_check(names[0], "sharded_step", tensors,
                           op_code=int(op), wildcard=sub)
@@ -1346,13 +1554,15 @@ class Engine:
             fn = self._builder(
                 ("sharded_step", op, prescale_factor, postscale_factor,
                  shapes, dtypes, bkey, st_shapes, st_dtypes, update_key,
-                 mode != "off"),
+                 mode != "off", ag_algos),
                 lambda: C.build_sharded_step(
                     mesh, self._axis(), op, shapes,
                     [t.dtype for t in tensors],
                     buckets, st_shapes, st_dtypes, update_fn,
                     prescale_factor, postscale_factor,
-                    pipeline=(mode != "off")))
+                    pipeline=(mode != "off"),
+                    local_size=self.topology.local_size,
+                    ag_algos=ag_algos))
             outs = self._dispatch(
                 names,
                 lambda: fn(*([self.backend.to_global(p, batched=True)
@@ -1393,10 +1603,11 @@ class Engine:
         upd_group = LaunchGroup(outs[-1])
         failpoint("overlap.prefetch")
         ag_fn = self._builder(
-            ("zero1_prefetch_allgather", shapes, dtypes, bkey),
+            ("zero1_prefetch_allgather", shapes, dtypes, bkey, ag_algos),
             lambda: C.build_grouped_allgather(
                 mesh, self._axis(), shapes, [t.dtype for t in tensors],
-                buckets, pipeline=True))
+                buckets, pipeline=True,
+                local_size=self.topology.local_size, algos=ag_algos))
         ag_outs = self._dispatch(names[:len(tensors)],
                                  lambda: ag_fn(*shard_garrs))
         ag_group = LaunchGroup(ag_outs[-1])
@@ -1438,9 +1649,19 @@ class Engine:
         hot peers' deferred check still sees an unchanged world)."""
         x = jnp.asarray(tensor)
         sub = self._consume_substitute()
-        self._m_account("allgather", [x])
+        ag_algo = self._choose_algo("allgather", x.nbytes)
+        if self._m_enabled and self.backend.size() > 1:
+            self._m_algo.inc(kind="allgather", algo=ag_algo)
+        links = None
+        if self.backend.size() > 1 and (self._m_enabled
+                                        or self.trace is not None):
+            links = [C.link_split(ag_algo, x.nbytes,
+                                  self.topology.local_size,
+                                  kind="allgather")]
+        self._m_account("allgather", [x], links)
         self._replay.observe("allgather", sub, [x], name)
-        name = self._register(name, "allgather", x.nbytes)
+        name = self._register(name, "allgather", x.nbytes,
+                              link_bytes=links[0] if links else None)
         key_hash = _sub_hash if _sub_hash is not None else \
             self._meta_hash(name)
         # allgather's op_or_root meta field carries (hash << 1) | equal_bit
@@ -1481,8 +1702,8 @@ class Engine:
             d0 = max_d0
         pad = max_d0 - d0
         xp = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1)) if pad else x
-        if self.config.hierarchical_allgather and self._hierarchical_ok():
-            local = self.backend.local_size()
+        if ag_algo == C.ALGO_HIERARCHICAL:
+            local = self.topology.local_size
             fn = self._builder(
                 ("hier_allgather", local),
                 lambda: C.build_hierarchical_allgather(mesh, self._axis(),

@@ -46,6 +46,7 @@ import jax.numpy as jnp
 
 from ..common.lru import lru_get, lru_put
 from ..metrics import registry as metrics_registry
+from ..ops import collectives as _C
 
 # step counters in tensor names ("grad.s17", "bench.grad.42") must not make
 # otherwise-identical steps look distinct — normalize digit runs away
@@ -171,6 +172,12 @@ class _Armed(NamedTuple):
     # zero1_prefetch as resolved when the stage plan was built — a live
     # flip of the knob must rebuild the armed program
     prefetch: bool = True
+    # topology-aware algorithm selection (ISSUE 10): the knob state the
+    # per-bucket algos embedded in `segments` were resolved under (a live
+    # move rebuilds the armed program), plus the total per-link byte
+    # split stamped on the fused launch's trace event
+    algo_sig: tuple = ()
+    link_bytes: Optional[dict] = None
 
 
 class StepReplay:
@@ -411,6 +418,7 @@ class StepReplay:
         hier = self._hier_local()
         if (armed.threshold != cfg.fusion_threshold_bytes
                 or armed.hier_local != hier
+                or armed.algo_sig != self._algo_sig()
                 or armed.mode != self._overlap_mode(armed.nbytes,
                                                     armed.n_buckets,
                                                     armed.has_sharded)
@@ -418,6 +426,15 @@ class StepReplay:
             armed = self._build_armed(stream)
             ent["armed"] = armed
         return armed
+
+    def _algo_sig(self) -> tuple:
+        """Knob state the per-bucket algorithm selection depends on — a
+        move of any of these must rebuild armed programs so eager warmup
+        and the armed program always resolve the same schedule (the
+        fusion-threshold rebuild contract applied to ISSUE 10). One
+        source of truth: the engine's signature, also used by the
+        grouped path's mid-call reuse guard."""
+        return self.engine._algo_sig()
 
     def _overlap_mode(self, nbytes: int, n_buckets: int,
                       has_sharded: bool) -> str:
@@ -486,9 +503,17 @@ class StepReplay:
                                      dtype=np.int64))
             join_metas = rows
         hier_local = self._hier_local()
+        topo_local = eng.topology.local_size
         built = []
         seg_dtypes = []
         nbytes = 0
+        link_total: Dict[str, int] = {}
+
+        def _note_links(algo: str, b: int, kind: str = "allreduce"):
+            for link, v in _C.link_split(algo, b, topo_local,
+                                         kind=kind).items():
+                link_total[link] = link_total.get(link, 0) + v
+
         for seg in segs:
             cls = seg["cls"]
             seg_dtypes.append(tuple(seg["dtypes"]))
@@ -498,20 +523,45 @@ class StepReplay:
                 # fusion threshold, which may have moved since the sharded
                 # state was initialized (shard shapes are pinned to it)
                 _, op_code, pre, post, update_key, n_grads, bkey = seg["key"]
-                nbytes += sum(
-                    _LeafProxy(s, d).nbytes
-                    for s, d in zip(seg["shapes"][:n_grads],
-                                    seg["dtypes"][:n_grads]))
+                proxies = [_LeafProxy(s, d)
+                           for s, d in zip(seg["shapes"][:n_grads],
+                                           seg["dtypes"][:n_grads])]
+                nbytes += sum(p.nbytes for p in proxies)
+                # the rs leg is pinned flat; the return ag picks per
+                # bucket — the SAME selection the eager warmup path made
+                # (engine.sharded_step), so armed and eager programs agree
+                ag_algos = tuple(
+                    eng._choose_algo("allgather",
+                                     sum(proxies[i].nbytes for i in b))
+                    for b in bkey)
+                for algo, b in zip(ag_algos, bkey):
+                    bb = sum(proxies[i].nbytes for i in b)
+                    _note_links("flat", bb)                    # rs leg
+                    _note_links(algo, bb, kind="allgather")    # ag leg
                 built.append(("sharded", (op_code, update_key, n_grads),
-                              pre, post, 0, tuple(seg["shapes"]), bkey))
+                              pre, post, (topo_local, ag_algos),
+                              tuple(seg["shapes"]), bkey))
                 continue
             _, code, pre, post = seg["key"]
             proxies = [_LeafProxy(s, d)
                        for s, d in zip(seg["shapes"], seg["dtypes"])]
             nbytes += sum(p.nbytes for p in proxies)
             buckets = bucket_by_size(proxies, cfg.fusion_threshold_bytes)
-            built.append((cls, code, pre, post,
-                          hier_local if cls == "reduce" else 0,
+            if cls == "reduce":
+                # per-bucket topology-aware lowering (ISSUE 10), resolved
+                # through the same engine selection the warmup path used
+                algos = tuple(
+                    eng._choose_algo("allreduce",
+                                     sum(proxies[i].nbytes for i in b))
+                    for b in buckets)
+                for algo, b in zip(algos, buckets):
+                    _note_links(algo, sum(proxies[i].nbytes for i in b))
+                topo_field = (topo_local, algos)
+            else:
+                for b in buckets:
+                    _note_links("flat", sum(proxies[i].nbytes for i in b))
+                topo_field = 0
+            built.append((cls, code, pre, post, topo_field,
                           tuple(seg["shapes"]),
                           tuple(tuple(b) for b in buckets)))
         n_buckets = sum(len(seg[6]) for seg in built)
@@ -520,12 +570,14 @@ class StepReplay:
         prefetch = bool(cfg.zero1_prefetch)
         stages = (self._stage_plan(built, seg_dtypes, prefetch)
                   if mode == "staged" else ())
+        algo_sig = self._algo_sig()
         return _Armed(stream, tuple(built),
                       ("replay_step", stream, cfg.fusion_threshold_bytes,
-                       hier_local, mode),
+                       hier_local, mode, algo_sig,
+                       tuple(seg[4] for seg in built)),
                       nbytes, cfg.fusion_threshold_bytes, hier_local,
                       join_metas, join_kind, mode, stages, n_buckets,
-                      has_sharded, prefetch)
+                      has_sharded, prefetch, algo_sig, dict(link_total))
 
     @staticmethod
     def _stage_plan(built: tuple, seg_dtypes: list,
@@ -545,12 +597,14 @@ class StepReplay:
         - ``("zupd", segment, in_idx, state_out_idx)`` — rs + shard-local
           update, emitting stacked shards + new state;
         - ``("zag", grad_shapes, grad_dtypes, buckets, out_idx,
-          update_key)`` — the prefetch all-gather, consuming the previous
-          zupd stage's shard outputs."""
+          update_key, local_size, ag_algos)`` — the prefetch all-gather,
+          consuming the previous zupd stage's shard outputs (per-bucket
+          flat/hierarchical selection riding along, ISSUE 10)."""
         stages = []
         base = 0
         for seg, dtypes in zip(built, seg_dtypes):
-            cls, code, pre, post, local, shapes, buckets = seg
+            cls, code, pre, post, topo_field, shapes, buckets = seg
+            local, algos = _C._seg_algo_spec(topo_field, len(buckets))
             if cls == "sharded" and not prefetch:
                 # prefetch disabled: one fused rs->update->ag sub-launch
                 io = tuple(range(base, base + len(shapes)))
@@ -564,12 +618,12 @@ class StepReplay:
                 stages.append(("zag", tuple(shapes[:n_grads]),
                                tuple(dtypes[:n_grads]), buckets,
                                tuple(range(base, base + n_grads)),
-                               update_key))
+                               update_key, local, algos))
             else:
-                for idxs in buckets:
+                for bi, idxs in enumerate(buckets):
                     sub_shapes = tuple(shapes[i] for i in idxs)
-                    sub_seg = (cls, code, pre, post, local, sub_shapes,
-                               (tuple(range(len(idxs))),))
+                    sub_seg = (cls, code, pre, post, (local, (algos[bi],)),
+                               sub_shapes, (tuple(range(len(idxs))),))
                     io = tuple(base + i for i in idxs)
                     stages.append(("seg", sub_seg, io, io))
             base += len(shapes)
@@ -622,7 +676,8 @@ class StepReplay:
             # replays the same stream in the same step, so the per-name
             # sequence numbers agree)
             eng.trace.record_enqueue(rep_name, "replay", armed.nbytes,
-                                     eng.world_version)
+                                     eng.world_version,
+                                     link_bytes=armed.link_bytes)
         if eng.on_enqueue is not None:
             eng.on_enqueue(rep_name, "replay", armed.nbytes)
         if armed.mode == "staged" and armed.stages:
@@ -732,17 +787,19 @@ class StepReplay:
                     slot_garrs[i] = outs[len(buckets) + pos]
                     slot_groups[i] = group
             else:  # "zag": the prefetch leg, consuming the zupd shards
-                _, gshapes, gdtypes, buckets, out_idx, update_key = st
+                (_, gshapes, gdtypes, buckets, out_idx, update_key,
+                 ag_local, ag_algos) = st
                 failpoint("overlap.prefetch")
                 # same cache key as the eager prefetch leg (engine.py's
                 # sharded_step): the programs are byte-identical, so the
                 # first staged step reuses the warmup path's compile
                 fn = eng._builder(
                     ("zero1_prefetch_allgather", gshapes, gdtypes,
-                     buckets),
+                     buckets, ag_algos),
                     lambda: engine_mod.C.build_grouped_allgather(
                         mesh, axis, gshapes, gdtypes, buckets,
-                        pipeline=True))
+                        pipeline=True, local_size=ag_local,
+                        algos=ag_algos))
                 shards = held_shards
                 outs = engine_mod._translate_failure(lambda: fn(*shards))
                 group = engine_mod.LaunchGroup(outs[-1])

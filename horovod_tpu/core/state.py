@@ -187,6 +187,13 @@ class GlobalState:
             # whether overlap pays is a per-runtime fact — dispatch
             # overhead vs wire time — exactly the step_replay trade.
             categorical += ["overlap_pipeline"]
+            # topology-aware collective algorithm selection (ISSUE 10):
+            # env-resolved base (auto / forced) vs flat-ring everywhere.
+            # Always expressible — selection demotes (never crashes) on
+            # topologies an algorithm cannot serve, and the choice is
+            # deterministic in (bytes, topology, knobs) so every rank
+            # flips identically at sample boundaries.
+            categorical += ["collective_algo"]
             self.parameter_manager = ParameterManager(
                 warmup_samples=cfg.autotune_warmup_samples,
                 steps_per_sample=cfg.autotune_steps_per_sample,
@@ -209,6 +216,7 @@ class GlobalState:
                     "step_replay": cfg.step_replay,
                     "shard_optimizer": cfg.shard_optimizer,
                     "overlap_pipeline": cfg.overlap_pipeline != "off",
+                    "collective_algo": cfg.collective_algo != "flat",
                 })
             self.engine.parameter_manager = self.parameter_manager
 

@@ -60,7 +60,13 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
                    "metadata exchanges, replay steps)"),
     "hvd_tpu_wire_bytes_total": (
         "counter", "Collective payload bytes submitted by this rank, by op "
-                   "kind and dtype"),
+                   "kind, dtype, and fabric link (hierarchical buckets "
+                   "split into their ici and dcn legs; everything else "
+                   "rides link=\"flat\")"),
+    "hvd_tpu_collective_algo_total": (
+        "counter", "Topology-aware algorithm selections, one per fusion "
+                   "bucket, by op kind and algorithm "
+                   "(flat/tree/hierarchical)"),
     "hvd_tpu_collectives_total": (
         "counter", "Collective operations submitted, by op kind"),
     "hvd_tpu_fusion_buckets_total": (

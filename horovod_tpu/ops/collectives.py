@@ -22,9 +22,10 @@ All builders are shape-polymorphic only through the jit cache: each distinct
 
 from __future__ import annotations
 
+import logging
 import math
 
-from typing import Sequence
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -34,7 +35,177 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import compat as _compat  # noqa: F401  (aliases jax.shard_map)
 from jax import shard_map
 
+from ..common.env import DEFAULT_TREE_THRESHOLD_BYTES
 from ..common.reduce_ops import ReduceOp
+
+logger = logging.getLogger("horovod_tpu")
+
+# ---------------------------------------------------------------------------
+# Topology-aware algorithm selection (ISSUE 10)
+#
+# Nothing in the stack used to *choose* a lowering: every message size got
+# the same program, and hierarchy was an all-or-nothing env knob. This is
+# the selection layer the reference implements as OperationManager priority
+# dispatch (operations.cc:142-249) plus NCCL's per-size algorithm pick,
+# rebuilt per fusion bucket: flat ring, tree (recursive halving/doubling
+# for latency-bound small buckets), or the hierarchical ICI/DCN ladder,
+# per (kind, bytes, Topology).
+# ---------------------------------------------------------------------------
+
+ALGO_FLAT = "flat"
+ALGO_TREE = "tree"
+ALGO_HIERARCHICAL = "hierarchical"
+ALGORITHMS = (ALGO_FLAT, ALGO_TREE, ALGO_HIERARCHICAL)
+
+# kinds the selection layer covers; everything else is always flat
+_SELECTABLE_KINDS = ("allreduce", "reducescatter", "allgather")
+
+_warned_demotions: set = set()
+
+
+def _demote(key: tuple, msg: str) -> str:
+    """One-time WARNING per (reason key); returns the flat algorithm —
+    the satellite fix for the hard divisibility asserts: an invalid
+    forcing or topology degrades, it never crashes."""
+    if key not in _warned_demotions:
+        _warned_demotions.add(key)
+        logger.warning("collective algorithm selection: %s; using flat", msg)
+    return ALGO_FLAT
+
+
+def _is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def validate_algorithm(kind: str, algo: str, n: int, local_size: int) -> str:
+    """Demote an algorithm the (kind, world, topology) cannot express:
+
+    - tree needs a power-of-2 world (the recursive-doubling pair rounds)
+      and only applies to reductions;
+    - hierarchical needs an exact non-trivial (cross, local)
+      factorization, and never applies to reduce-scatter — the ZeRO-1
+      shard-ownership convention (rank r owns contiguous chunk r of the
+      padded buffer, :func:`shard_spec`) pins the scatter to the flat
+      ring: a two-level scatter permutes chunk ownership, which would
+      corrupt shard-shaped optimizer state and the checkpoint layout.
+    """
+    if algo not in ALGORITHMS:
+        return _demote((kind, algo), f"unknown algorithm {algo!r}")
+    if n <= 1 or algo == ALGO_FLAT:
+        return ALGO_FLAT
+    if algo == ALGO_TREE:
+        if kind not in ("allreduce",):
+            return _demote((kind, algo),
+                           f"tree does not apply to {kind}")
+        if not _is_pow2(n):
+            return _demote((kind, algo, n),
+                           f"tree needs a power-of-2 world, have {n}")
+        return ALGO_TREE
+    # hierarchical
+    if kind == "reducescatter":
+        return _demote((kind, algo),
+                       "reduce-scatter keeps the flat ring (shard-"
+                       "ownership invariant, see validate_algorithm)")
+    if not (1 < local_size < n and n % local_size == 0):
+        return _demote((kind, algo, n, local_size),
+                       f"no exact (cross, local) factorization for "
+                       f"world {n} with local_size {local_size}")
+    return ALGO_HIERARCHICAL
+
+
+def choose_algorithm(kind: str, nbytes: int, topology,
+                     force: str = "auto",
+                     tree_threshold_bytes: int =
+                     DEFAULT_TREE_THRESHOLD_BYTES) -> str:
+    """Pick the lowering for ONE bucket of ``kind`` carrying ``nbytes``
+    per rank over ``topology`` (a :class:`~..parallel.mesh.Topology`).
+
+    ``force`` != "auto" pins the choice (demoted when inexpressible).
+    Auto rules:
+
+    - reductions at or under ``tree_threshold_bytes`` on a power-of-2
+      world of >= 4 lower to the tree form — log2(n) latency steps
+      instead of the ring's 2(n-1), the classic small-message win (at
+      n=2 tree and flat are the same single exchange, so auto never
+      bothers);
+    - above the threshold, allreduce/allgather take the hierarchical
+      ICI/DCN ladder when the topology has an exact non-trivial slice
+      decomposition (cross traffic 1/local_size — the reference's
+      NCCL-RS -> MPI-AR -> NCCL-AG ladder, nccl_operations.cc:180-383);
+    - otherwise the flat ring.
+
+    Deterministic in (kind, bytes, topology, knobs) — every rank that
+    submits the same collective computes the same schedule, which is what
+    lets the replay/overlap paths and Join substitutes resolve identical
+    programs without negotiation.
+    """
+    n = int(topology.size)
+    local = int(topology.local_size)
+    if n <= 1 or kind not in _SELECTABLE_KINDS:
+        return ALGO_FLAT
+    if force != "auto":
+        return validate_algorithm(kind, force, n, local)
+    if (kind == "allreduce" and nbytes <= tree_threshold_bytes
+            and n >= 4 and _is_pow2(n)):
+        return ALGO_TREE
+    if kind in ("allreduce", "allgather") and topology.hierarchical_ok:
+        return ALGO_HIERARCHICAL
+    return ALGO_FLAT
+
+
+def link_split(algo: str, nbytes: int, local_size: int,
+               kind: str = "allreduce") -> dict:
+    """Per-fabric attribution of one bucket's payload bytes (the
+    ``link`` label on ``hvd_tpu_wire_bytes_total``): each byte is counted
+    once, attributed to the fabric that paces it.
+
+    - hierarchical **allreduce**: the cross-slice exchange carries
+      1/local_size of the payload over DCN (the ladder's whole point),
+      the rest rides the intra-slice ICI legs;
+    - hierarchical **allgather**: the cross gather moves whole slice
+      blocks — EVERY payload byte crosses DCN (the win there is one
+      contiguous block transfer instead of a whole-world ring, not a
+      byte reduction), so the full payload is attributed to DCN;
+    - every other lowering is whole-fabric ("flat")."""
+    if algo == ALGO_HIERARCHICAL and local_size > 1:
+        if kind == "allgather":
+            return {"dcn": int(nbytes)}
+        dcn = int(nbytes) // local_size
+        return {"dcn": dcn, "ici": int(nbytes) - dcn}
+    return {"flat": int(nbytes)}
+
+
+def slice_groups(n: int, local_size: int):
+    """The ONE slice-major rank-layout rule every two-level collective
+    shares: ``(local_groups, cross_groups)`` where slice c owns the
+    contiguous rank block ``[c*local_size, (c+1)*local_size)`` and cross
+    group l spans the slices at local index l. Every hierarchical builder
+    derives its replica groups here (and
+    ``Topology.local_groups/cross_groups`` mirror the same rule for
+    callers) — a layout change must never be applied to one ladder leg
+    and not another, or reduce and gather silently disagree on chunk
+    ownership."""
+    cross = n // local_size
+    local_groups = [[c * local_size + l for l in range(local_size)]
+                    for c in range(cross)]
+    cross_groups = [[c * local_size + l for c in range(cross)]
+                    for l in range(local_size)]
+    return local_groups, cross_groups
+
+
+def tree_groups(n: int) -> List[List[List[int]]]:
+    """Recursive-doubling round structure for a power-of-2 world: round k
+    pairs ranks differing in bit k. After log2(n) pairwise psums every
+    rank holds the full reduction — log2(n) latency steps vs the ring's
+    2(n-1) (Thakur et al. 2005, the MPICH allreduce small-message
+    algorithm)."""
+    assert _is_pow2(n), n
+    rounds = []
+    k = 1
+    while k < n:
+        rounds.append([[r, r | k] for r in range(n) if not (r & k)])
+        k <<= 1
+    return rounds
 
 # ---------------------------------------------------------------------------
 # Layer 1: in-SPMD primitives (use inside shard_map / pjit-traced code)
@@ -207,14 +378,17 @@ def build_hierarchical_allreduce(mesh: Mesh, axis: str, local_size: int,
     decomposition is expressed with ``axis_index_groups``: reduce-scatter
     within each local (ICI) group, psum across groups (DCN), all-gather back
     — cross traffic shrinks by 1/local_size.
+
+    A world the ``local_size`` does not factorize demotes to the flat
+    builder with a one-time WARNING (never an assert): non-divisible
+    elastic worlds keep training on the flat ring.
     """
     n = int(mesh.devices.size)
-    assert n % local_size == 0, (n, local_size)
-    cross = n // local_size
-    local_groups = [[c * local_size + l for l in range(local_size)]
-                    for c in range(cross)]
-    cross_groups = [[c * local_size + l for c in range(cross)]
-                    for l in range(local_size)]
+    if validate_algorithm("allreduce", ALGO_HIERARCHICAL, n,
+                          local_size) != ALGO_HIERARCHICAL:
+        return build_allreduce(mesh, axis, op, prescale_factor,
+                               postscale_factor)
+    local_groups, cross_groups = slice_groups(n, local_size)
 
     def body(x):  # x block: (1, *s); output replicated (see build_allreduce)
         v = x[0]
@@ -253,6 +427,40 @@ def build_hierarchical_allreduce(mesh: Mesh, axis: str, local_size: int,
     return jax.jit(fn)
 
 
+def build_tree_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
+                         prescale_factor: float = 1.0,
+                         postscale_factor: float = 1.0):
+    """Stacked recursive-doubling allreduce (the tree form
+    :func:`choose_algorithm` picks for latency-bound small buckets):
+    log2(n) pairwise psum rounds instead of the ring's 2(n-1) steps.
+    Non-power-of-2 worlds demote to the flat builder with a one-time
+    WARNING; MIN/MAX/PRODUCT ops take the flat reduction inside the same
+    program (the tree decomposition is additive-only)."""
+    n = int(mesh.devices.size)
+    if validate_algorithm("allreduce", ALGO_TREE, n, 0) != ALGO_TREE:
+        return build_allreduce(mesh, axis, op, prescale_factor,
+                               postscale_factor)
+    reduce_flat = _make_reduce_flat(axis, op, n, 0, ALGO_TREE)
+
+    def body(x):  # x block: (1, *s); output replicated by construction
+        v = x[0]
+        if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            return allreduce_p(v, axis, op, prescale_factor,
+                               postscale_factor)
+        if prescale_factor != 1.0:
+            v = v * prescale_factor
+        out = reduce_flat(v.reshape(-1)).reshape(v.shape)
+        if postscale_factor != 1.0:
+            out = out * postscale_factor
+        return out
+
+    # pair-group psums are replicated after the last round but the VMA
+    # checker cannot infer replication across partial groups
+    fn = _shmap(body, mesh, axis, in_specs=P(axis), out_specs=P(),
+                check_vma=False)
+    return jax.jit(fn)
+
+
 def build_hierarchical_allgather(mesh: Mesh, axis: str, local_size: int):
     """Two-level stacked allgather (HOROVOD_HIERARCHICAL_ALLGATHER; reference
     MPIHierarchicalAllgather mpi_operations.cc:178: node-local gather through
@@ -263,14 +471,15 @@ def build_hierarchical_allgather(mesh: Mesh, axis: str, local_size: int):
     slow links carry whole node blocks once instead of participating in the
     full-world ring. Group ranges are contiguous, so block order equals rank
     order and the result matches the flat allgather exactly.
+
+    A world the ``local_size`` does not factorize demotes to the flat
+    builder with a one-time WARNING (never an assert).
     """
     n = int(mesh.devices.size)
-    assert n % local_size == 0, (n, local_size)
-    cross = n // local_size
-    local_groups = [[c * local_size + l for l in range(local_size)]
-                    for c in range(cross)]
-    cross_groups = [[c * local_size + l for c in range(cross)]
-                    for l in range(local_size)]
+    if validate_algorithm("allgather", ALGO_HIERARCHICAL, n,
+                          local_size) != ALGO_HIERARCHICAL:
+        return build_allgather(mesh, axis)
+    local_groups, cross_groups = slice_groups(n, local_size)
 
     def body(x):  # (1, d0, *s)
         local_block = lax.all_gather(x[0], axis, axis=0, tiled=True,
@@ -351,22 +560,51 @@ def build_reducescatter(mesh: Mesh, axis: str, op: ReduceOp = ReduceOp.SUM,
     return jax.jit(fn)
 
 
-def _make_reduce_flat(axis: str, op: ReduceOp, n: int, local_size: int):
-    """Flat-buffer reduction closure shared by the fused-bucket builders:
-    hierarchical RS/RS/AG/AG ladder when ``local_size > 1`` (reference
-    NCCLHierarchicalAllreduce nccl_operations.cc:180-383), flat psum
-    otherwise."""
-    if local_size > 1:
-        assert n % local_size == 0, (n, local_size)
-        cross = n // local_size
-        local_groups = [[c * local_size + l for l in range(local_size)]
-                        for c in range(cross)]
-        cross_groups = [[c * local_size + l for c in range(cross)]
-                        for l in range(local_size)]
+def _resolve_reduce_algo(algo: Optional[str], n: int,
+                         local_size: int) -> str:
+    """Normalize a builder's reduction-algorithm request. ``None`` keeps
+    the legacy contract (``local_size > 1`` selects hierarchical, flat
+    otherwise); explicit algorithms are validated and demoted — never
+    asserted — so non-divisible worlds and invalid forcings compile the
+    flat program with a one-time WARNING."""
+    if algo is None:
+        algo = ALGO_HIERARCHICAL if local_size > 1 else ALGO_FLAT
+    return validate_algorithm("allreduce", algo, n, local_size)
+
+
+def _make_reduce_flat(axis: str, op: ReduceOp, n: int, local_size: int,
+                      algo: Optional[str] = None):
+    """Flat-buffer reduction closure shared by the fused-bucket builders,
+    per algorithm:
+
+    - ``flat``: one whole-world psum (XLA's ring);
+    - ``tree``: log2(n) pairwise psum rounds (recursive doubling) — the
+      latency-bound small-bucket form;
+    - ``hierarchical``: RS/RS/AG/AG ladder over node-local + cross
+      replica groups (reference NCCLHierarchicalAllreduce,
+      nccl_operations.cc:180-383).
+
+    ``algo=None`` preserves the legacy selection (hierarchical iff
+    ``local_size > 1``). Non-SUM/AVERAGE ops always take the flat path —
+    tree/hierarchical decompositions only pay for (and are only defined
+    over) the additive reductions.
+    """
+    algo = _resolve_reduce_algo(algo, n, local_size)
+    if algo == ALGO_HIERARCHICAL:
+        local_groups, cross_groups = slice_groups(n, local_size)
+    elif algo == ALGO_TREE:
+        rounds = tree_groups(n)
 
     def _reduce_flat(flat):
-        if op not in (ReduceOp.SUM, ReduceOp.AVERAGE) or local_size <= 1:
+        if op not in (ReduceOp.SUM, ReduceOp.AVERAGE) or algo == ALGO_FLAT:
             return allreduce_p(flat, axis, op, 1.0, 1.0)
+        if algo == ALGO_TREE:
+            out = flat
+            for groups in rounds:
+                out = lax.psum(out, axis, axis_index_groups=groups)
+            if op == ReduceOp.AVERAGE:
+                out = out / n
+            return out
         pad = (-flat.shape[0]) % n
         if pad:
             flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
@@ -387,11 +625,37 @@ def _make_reduce_flat(axis: str, op: ReduceOp, n: int, local_size: int):
     return _reduce_flat
 
 
+def _resolved_bucket_algos(n: int, local_size: int, algos,
+                           n_buckets: int) -> tuple:
+    """Per-bucket resolved algorithm list for a grouped reduce builder:
+    ``algos=None`` resolves every bucket through the legacy local_size
+    rule; explicit entries are validated (demoted, never asserted)."""
+    if algos is None:
+        algos = (None,) * n_buckets
+    return tuple(_resolve_reduce_algo(a, n, local_size) for a in algos)
+
+
+def _bucket_reducers(axis: str, op: ReduceOp, n: int, local_size: int,
+                     algos, n_buckets: int) -> list:
+    """One flat-buffer reduction closure per bucket, memoized per resolved
+    algorithm (buckets sharing an algorithm share the closure — and the
+    replica-group tables it captures)."""
+    resolved = _resolved_bucket_algos(n, local_size, algos, n_buckets)
+    cache: dict = {}
+    out = []
+    for a in resolved:
+        if a not in cache:
+            cache[a] = _make_reduce_flat(axis, op, n, local_size, a)
+        out.append(cache[a])
+    return out
+
+
 def build_fused_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
                           shapes, dtype,
                           prescale_factor: float = 1.0,
                           postscale_factor: float = 1.0,
-                          local_size: int = 0):
+                          local_size: int = 0,
+                          algo: Optional[str] = None):
     """One-launch fused bucket allreduce: takes the stacked *packed* buffer
     (n, total) and returns one stacked (n, *shape_i) array per bucket member,
     reduced — pack→collective→unpack in a single jitted program (the whole
@@ -400,11 +664,14 @@ def build_fused_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
 
     ``local_size > 0`` selects the hierarchical ladder (reference
     NCCLHierarchicalAllreduce nccl_operations.cc:180-383) on the packed
-    buffer; 0 = flat psum.
+    buffer; 0 = flat psum. ``algo`` (ISSUE 10) overrides that legacy
+    rule with an explicit flat/tree/hierarchical choice from
+    :func:`choose_algorithm`.
     """
     n = int(mesh.devices.size)
     sizes = [math.prod(s) for s in shapes]
-    _reduce_flat = _make_reduce_flat(axis, op, n, local_size)
+    _reduce_flat = _make_reduce_flat(axis, op, n, local_size, algo)
+    resolved = _resolve_reduce_algo(algo, n, local_size)
 
     def body(x):  # x block: (1, total)
         flat = x[0]
@@ -423,7 +690,7 @@ def build_fused_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
 
     fn = _shmap(body, mesh, axis, in_specs=P(axis),
                 out_specs=tuple(P() for _ in shapes),
-                check_vma=(local_size <= 1))
+                check_vma=(resolved == ALGO_FLAT))
     return jax.jit(fn)
 
 
@@ -462,7 +729,8 @@ def build_grouped_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
                             prescale_factor: float = 1.0,
                             postscale_factor: float = 1.0,
                             local_size: int = 0,
-                            pipeline: bool = False):
+                            pipeline: bool = False,
+                            algos: Optional[Sequence[str]] = None):
     """ONE launch for the whole grouped reduce+unpack: the per-bucket
     packed buffers (from :func:`build_pack_group`, stacked (n, total_b))
     go in, every reduced tensor of the group comes out — one collective
@@ -486,10 +754,17 @@ def build_grouped_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
         (scale..., reduce..., unpack...) leaves the collectives mutually
         independent and adjacent, which is what XLA's async-collective
         conversion / latency-hiding scheduler overlaps.
+      algos: per-bucket algorithm ("flat"/"tree"/"hierarchical") from
+        :func:`choose_algorithm` (ISSUE 10); None = the legacy local_size
+        rule for every bucket. The small latency-bound bucket of a step
+        can lower to the tree form while its big bucket takes the
+        hierarchical ladder, in the SAME program.
     """
     _check_bucket_dtypes(dtypes, buckets)
     n = int(mesh.devices.size)
-    _reduce_flat = _make_reduce_flat(axis, op, n, local_size)
+    reducers = _bucket_reducers(axis, op, n, local_size, algos,
+                                len(buckets))
+    resolved = _resolved_bucket_algos(n, local_size, algos, len(buckets))
     sizes = [math.prod(s) for s in shapes]
 
     def body(*packed):  # per-bucket blocks (1, total_b)
@@ -501,7 +776,7 @@ def build_grouped_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
                 if prescale_factor != 1.0:
                     flat = flat * prescale_factor
                 flats.append(flat)
-            reds = [_reduce_flat(f) for f in flats]
+            reds = [reducers[b](f) for b, f in enumerate(flats)]
             if postscale_factor != 1.0:
                 reds = [r * postscale_factor for r in reds]
             for b, idxs in enumerate(buckets):
@@ -511,7 +786,7 @@ def build_grouped_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
             flat = packed[b][0]
             if prescale_factor != 1.0:
                 flat = flat * prescale_factor
-            red = _reduce_flat(flat)
+            red = reducers[b](flat)
             if postscale_factor != 1.0:
                 red = red * postscale_factor
             offset = 0
@@ -524,7 +799,7 @@ def build_grouped_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
     fn = _shmap(body, mesh, axis,
                 in_specs=tuple(P(axis) for _ in buckets),
                 out_specs=tuple(P() for _ in shapes),
-                check_vma=(local_size <= 1))
+                check_vma=all(a == ALGO_FLAT for a in resolved))
     return jax.jit(fn)
 
 
@@ -594,10 +869,29 @@ def _rs_flat(flat, axis: str, n: int, op: ReduceOp):
     return shard
 
 
-def _ag_flat(shard, axis: str, total: int):
+def _ag_flat(shard, axis: str, total: int, algo: str = ALGO_FLAT,
+             n: int = 0, local_size: int = 0):
     """Inverse of :func:`_rs_flat`: all-gather the per-rank shards and trim
-    the divisibility padding back off."""
-    full = lax.all_gather(shard, axis, axis=0, tiled=True)
+    the divisibility padding back off.
+
+    ``algo="hierarchical"`` gathers in two levels — intra-slice (ICI)
+    first, then whole slice blocks across slices (DCN) — so the slow
+    fabric carries each byte once in contiguous blocks (reference
+    MPIHierarchicalAllgather, mpi_operations.cc:178). Because the flat
+    shard convention assigns rank r contiguous chunk r and slice rank
+    blocks are contiguous, the local gather yields exactly slice c's
+    block and the cross gather concatenates blocks in rank order — the
+    result is bit-identical to the flat gather."""
+    if algo == ALGO_HIERARCHICAL and validate_algorithm(
+            "allgather", ALGO_HIERARCHICAL, n, local_size) \
+            == ALGO_HIERARCHICAL:
+        local_groups, cross_groups = slice_groups(n, local_size)
+        full = lax.all_gather(shard, axis, axis=0, tiled=True,
+                              axis_index_groups=local_groups)
+        full = lax.all_gather(full, axis, axis=0, tiled=True,
+                              axis_index_groups=cross_groups)
+    else:
+        full = lax.all_gather(shard, axis, axis=0, tiled=True)
     if full.shape[0] != total:
         full = full[:total]
     return full
@@ -615,7 +909,8 @@ def build_grouped_reducescatter(mesh: Mesh, axis: str, op: ReduceOp,
                                 shapes, dtypes, buckets,
                                 prescale_factor: float = 1.0,
                                 postscale_factor: float = 1.0,
-                                pipeline: bool = False):
+                                pipeline: bool = False,
+                                algos: Optional[Sequence[str]] = None):
     """ONE launch for a whole grouped reduce-scatter: the per-bucket packed
     buffers (from :func:`build_pack_group`, stacked (n, total_b)) go in, one
     stacked (n, shard_b) array per bucket comes out — rank r's addressable
@@ -627,9 +922,19 @@ def build_grouped_reducescatter(mesh: Mesh, axis: str, op: ReduceOp,
     Bucket totals need not divide n — shards are over the zero-padded
     buffer (:func:`shard_spec`). ``pipeline=True`` traces every bucket's
     scale before any reduce-scatter so the collectives issue back-to-back
-    (overlap-ready, ISSUE 6)."""
+    (overlap-ready, ISSUE 6).
+
+    ``algos`` is accepted for selection-layer symmetry (ISSUE 10) but the
+    scatter itself is ALWAYS the flat ring: the shard-ownership
+    convention (rank r owns contiguous chunk r — what ZeRO-1 state
+    shapes, checkpoints, and reshard all key on) is incompatible with a
+    two-level scatter's chunk permutation; non-flat entries demote with
+    a one-time WARNING (see :func:`validate_algorithm`)."""
     _check_bucket_dtypes(dtypes, buckets)
     n = int(mesh.devices.size)
+    if algos is not None:
+        for a in algos:
+            validate_algorithm("reducescatter", a, n, 0)
 
     def body(*packed):  # per-bucket blocks (1, total_b)
         outs = []
@@ -661,7 +966,9 @@ def build_grouped_reducescatter(mesh: Mesh, axis: str, op: ReduceOp,
 
 
 def build_grouped_allgather(mesh: Mesh, axis: str, shapes, dtypes, buckets,
-                            pipeline: bool = False):
+                            pipeline: bool = False,
+                            local_size: int = 0,
+                            algos: Optional[Sequence[str]] = None):
     """Inverse of :func:`build_grouped_reducescatter` and the return leg of
     the sharded optimizer step: per-bucket stacked shards (n, shard_b) in,
     every tensor of the group out — replicated, unpacked to its natural
@@ -669,21 +976,30 @@ def build_grouped_allgather(mesh: Mesh, axis: str, shapes, dtypes, buckets,
     program. ``pipeline=True`` issues every bucket's all-gather before any
     unpack is traced (bucket i's unpack no longer interposes between
     gather i and gather i+1 — overlap-ready, ISSUE 6); this is also the
-    program the ZeRO-1 prefetch leg launches under the step's tail."""
+    program the ZeRO-1 prefetch leg launches under the step's tail.
+    ``algos`` selects flat vs the two-level hierarchical gather per
+    bucket (ISSUE 10; order-preserving, see :func:`_ag_flat`)."""
     _check_bucket_dtypes(dtypes, buckets)
+    n = int(mesh.devices.size)
+    if algos is None:
+        algos = (ALGO_FLAT,) * len(buckets)
+    algos = tuple(validate_algorithm("allgather", a, n, local_size)
+                  for a in algos)
     sizes = [math.prod(s) for s in shapes]
     totals = [sum(sizes[i] for i in idxs) for idxs in buckets]
 
     def body(*shards):  # per-bucket blocks (1, shard_b)
         outs = [None] * len(shapes)
         if pipeline:
-            fulls = [_ag_flat(shards[b][0], axis, totals[b])
+            fulls = [_ag_flat(shards[b][0], axis, totals[b], algos[b],
+                              n, local_size)
                      for b in range(len(buckets))]
             for b, idxs in enumerate(buckets):
                 _unpack_flat(fulls[b], shapes, sizes, idxs, outs)
             return tuple(outs)
         for b, idxs in enumerate(buckets):
-            full = _ag_flat(shards[b][0], axis, totals[b])
+            full = _ag_flat(shards[b][0], axis, totals[b], algos[b],
+                            n, local_size)
             _unpack_flat(full, shapes, sizes, idxs, outs)
         return tuple(outs)
 
@@ -773,7 +1089,9 @@ def build_sharded_step(mesh: Mesh, axis: str, op: ReduceOp,
                        state_shapes, state_dtypes, update,
                        prescale_factor: float = 1.0,
                        postscale_factor: float = 1.0,
-                       pipeline: bool = False):
+                       pipeline: bool = False,
+                       local_size: int = 0,
+                       ag_algos: Optional[Sequence[str]] = None):
     """ONE launch for a whole ZeRO-1 optimizer step: per-bucket packed
     gradient buffers (stacked (n, total_b)) plus this rank's optimizer-state
     leaves (world-view lifted, genuinely different per rank) go in; the
@@ -792,9 +1110,17 @@ def build_sharded_step(mesh: Mesh, axis: str, op: ReduceOp,
     collectives back-to-back (all reduce-scatters, update, all
     all-gathers, then unpacks) so no unpack interposes between two
     collectives (ISSUE 6 overlap-ready ordering).
+
+    ``ag_algos`` selects flat vs hierarchical for the return all-gather
+    per bucket (ISSUE 10); the reduce-scatter leg is always the flat
+    ring (shard-ownership invariant, :func:`validate_algorithm`).
     """
     _check_bucket_dtypes(dtypes, buckets)
     n = int(mesh.devices.size)
+    if ag_algos is None:
+        ag_algos = (ALGO_FLAT,) * len(buckets)
+    ag_algos = tuple(validate_algorithm("allgather", a, n, local_size)
+                     for a in ag_algos)
     sizes = [math.prod(s) for s in shapes]
     totals = [sum(sizes[i] for i in idxs) for idxs in buckets]
 
@@ -825,13 +1151,15 @@ def build_sharded_step(mesh: Mesh, axis: str, op: ReduceOp,
         _check_state_leaves(state, new_state)
         outs = [None] * len(shapes)
         if pipeline:
-            fulls = [_ag_flat(new_shards[b], axis, totals[b])
+            fulls = [_ag_flat(new_shards[b], axis, totals[b], ag_algos[b],
+                              n, local_size)
                      for b in range(len(buckets))]
             for b, idxs in enumerate(buckets):
                 _unpack_flat(fulls[b], shapes, sizes, idxs, outs)
         else:
             for b, idxs in enumerate(buckets):
-                full = _ag_flat(new_shards[b], axis, totals[b])
+                full = _ag_flat(new_shards[b], axis, totals[b],
+                                ag_algos[b], n, local_size)
                 _unpack_flat(full, shapes, sizes, idxs, outs)
         return tuple(outs) + tuple(new_state)
 
@@ -846,6 +1174,24 @@ def build_sharded_step(mesh: Mesh, axis: str, op: ReduceOp,
                 + tuple(P() for _ in state_shapes),
                 check_vma=False)
     return jax.jit(fn)
+
+
+def _seg_algo_spec(field, n_buckets: int):
+    """Decode a replay segment's topology field (position 4): a bare int
+    is the legacy form — ``local_size``, > 1 meaning hierarchical for
+    every bucket — while a ``(local_size, algos)`` tuple carries the
+    per-bucket topology-aware selection (ISSUE 10). For "sharded"
+    segments the algo list applies to the return all-gather legs (the
+    reduce-scatter is pinned flat)."""
+    if isinstance(field, tuple):
+        local, algos = int(field[0]), tuple(field[1])
+        if len(algos) != n_buckets:
+            raise ValueError(
+                f"segment algo list has {len(algos)} entries for "
+                f"{n_buckets} buckets")
+    else:
+        local, algos = int(field), (None,) * n_buckets
+    return local, algos
 
 
 def build_replay_step(mesh: Mesh, axis: str, segments,
@@ -913,25 +1259,29 @@ def build_replay_step(mesh: Mesh, axis: str, segments,
                 packs[(si, bi)] = flat
         # -- phase 2: every collective, issued back-to-back --
         reds = {}    # (seg_idx, bucket_idx) -> reduced flat / shard
-        for si, (cls, code, pre, post, local_size, shapes,
+        for si, (cls, code, pre, post, topo_field, shapes,
                  buckets) in enumerate(segments):
+            local_size, algos = _seg_algo_spec(topo_field, len(buckets))
             if cls == "reduce":
-                reduce_flat = _make_reduce_flat(axis, ReduceOp(code), n,
-                                                local_size)
+                reducers = _bucket_reducers(axis, ReduceOp(code), n,
+                                            local_size, algos,
+                                            len(buckets))
             for bi in range(len(buckets)):
                 flat = packs[(si, bi)]
                 if cls == "sharded":
                     reds[(si, bi)] = _rs_flat(flat, axis, n,
                                               ReduceOp(code[0]))
                 elif cls == "reduce":
-                    reds[(si, bi)] = reduce_flat(flat)
+                    reds[(si, bi)] = reducers[bi](flat)
                 else:
                     reds[(si, bi)] = broadcast_p(flat, axis, code)
         # -- phase 3: shard-local updates + return all-gathers --
-        for si, (cls, code, pre, post, local_size, shapes,
+        for si, (cls, code, pre, post, topo_field, shapes,
                  buckets) in enumerate(segments):
             sizes = [math.prod(s) for s in shapes]
             if cls == "sharded":
+                local_size, ag_algos = _seg_algo_spec(topo_field,
+                                                      len(buckets))
                 op_code, update_key, n_grads = code
                 shards = [reds[(si, bi)] for bi in range(len(buckets))]
                 if post != 1.0:
@@ -942,7 +1292,9 @@ def build_replay_step(mesh: Mesh, axis: str, segments,
                     shards, state)
                 for bi, idxs in enumerate(buckets):
                     total = sum(sizes[i] for i in idxs)
-                    reds[(si, bi)] = _ag_flat(new_shards[bi], axis, total)
+                    reds[(si, bi)] = _ag_flat(
+                        new_shards[bi], axis, total,
+                        ag_algos[bi] or ALGO_FLAT, n, local_size)
                 for j, leaf in enumerate(new_state):
                     outs[bases[si] + n_grads + j] = leaf
             elif cls == "reduce" and post != 1.0:
@@ -962,8 +1314,9 @@ def build_replay_step(mesh: Mesh, axis: str, segments,
     def body(*ts):  # each rank's own local tensors, natural shapes
         outs = [None] * n_tensors
         base = 0
-        for cls, code, pre, post, local_size, shapes, buckets in segments:
+        for cls, code, pre, post, topo_field, shapes, buckets in segments:
             sizes = [math.prod(s) for s in shapes]
+            local_size, algos = _seg_algo_spec(topo_field, len(buckets))
             if cls == "sharded":
                 # rs -> shard-local update -> ag, fused in-stream: the
                 # sharded eager step replays as part of the same single
@@ -985,7 +1338,8 @@ def build_replay_step(mesh: Mesh, axis: str, segments,
                     shards, state)
                 for b, idxs in enumerate(buckets):
                     total = sum(sizes[i] for i in idxs)
-                    full = _ag_flat(new_shards[b], axis, total)
+                    full = _ag_flat(new_shards[b], axis, total,
+                                    algos[b] or ALGO_FLAT, n, local_size)
                     seg_outs = [None] * len(shapes)
                     _unpack_flat(full, shapes, sizes, idxs, seg_outs)
                     for i in idxs:
@@ -995,15 +1349,16 @@ def build_replay_step(mesh: Mesh, axis: str, segments,
                 base += len(shapes)
                 continue
             if cls == "reduce":
-                reduce_flat = _make_reduce_flat(axis, ReduceOp(code), n,
-                                                local_size)
-            for idxs in buckets:
+                reducers = _bucket_reducers(axis, ReduceOp(code), n,
+                                            local_size, algos,
+                                            len(buckets))
+            for b, idxs in enumerate(buckets):
                 flat = jnp.concatenate(
                     [jnp.ravel(ts[base + i]) for i in idxs])
                 if cls == "reduce":
                     if pre != 1.0:
                         flat = flat * pre
-                    red = reduce_flat(flat)
+                    red = reducers[b](flat)
                     if post != 1.0:
                         red = red * post
                 else:

@@ -127,10 +127,16 @@ class TraceRecorder:
         self._total += 1
 
     def record_enqueue(self, name: str, kind: str, nbytes: int,
-                       world_version: int) -> str:
+                       world_version: int,
+                       link_bytes: Optional[dict] = None) -> str:
         """Stamp one collective submission: bump the per-name sequence,
         mint the deterministic correlation id, and record the arrival
-        (enqueue-phase) event. Returns the correlation id."""
+        (enqueue-phase) event. Returns the correlation id.
+
+        ``link_bytes`` (ISSUE 10) is the payload's per-fabric split
+        ({"ici"/"dcn"/"flat": bytes}) from the topology-aware algorithm
+        selection; it rides the event so the merged trace and
+        tools/trace_report.py can break wire bytes down by link."""
         with self._lock:
             if name not in self._seq and len(self._seq) >= _MAX_SEQ_NAMES:
                 # bounded map: restart sequences. Events carrying the old
@@ -142,8 +148,11 @@ class TraceRecorder:
             corr = make_corr(name, world_version, seq)
             self._live[name] = corr
             self._world_version = world_version
-            self._append({"p": "enq", "t": time.monotonic(), "c": corr,
-                          "k": kind, "n": name, "b": int(nbytes)})
+            ev = {"p": "enq", "t": time.monotonic(), "c": corr,
+                  "k": kind, "n": name, "b": int(nbytes)}
+            if link_bytes:
+                ev["lb"] = {str(k): int(v) for k, v in link_bytes.items()}
+            self._append(ev)
             return corr
 
     def live_corr(self, name: str) -> Optional[str]:
@@ -395,12 +404,13 @@ def merge_segments(segments: Dict[int, dict]) -> List[dict]:
             if p == "enq":
                 tid = _tid_for(tids, ev.get("n", ""))
                 open_spans.setdefault(tid, []).append(ev.get("c"))
+                args = {"corr": ev.get("c"), "tensor": ev.get("n"),
+                        "bytes": ev.get("b", 0)}
+                if isinstance(ev.get("lb"), dict):
+                    args["link_bytes"] = ev["lb"]
                 out.append({"ph": "B", "ts": ts, "pid": rank, "tid": tid,
                             "name": str(ev.get("k", "")).upper(),
-                            "cat": "collective",
-                            "args": {"corr": ev.get("c"),
-                                     "tensor": ev.get("n"),
-                                     "bytes": ev.get("b", 0)}})
+                            "cat": "collective", "args": args})
             elif p == "done":
                 tid = _tid_for(tids, ev.get("n", ""))
                 stack = open_spans.get(tid)

@@ -522,6 +522,147 @@ def test_split_sharded_update_has_no_allgather():
             np.asarray(b.addressable_shards[0].data))
 
 
+# -- ISSUE 10: topology-aware algorithm selection ---------------------------
+
+_PAIR_GROUPS = r"replica_groups=\{\{0,1\},\{2,3\},\{4,5\},\{6,7\}\}"
+_NODE_GROUPS = r"replica_groups=\{\{0,1,2,3\},\{4,5,6,7\}\}"
+
+
+def _topo84():
+    from horovod_tpu.parallel.mesh import Topology
+    return Topology(size=8, local_size=4, platform="tpu", source="override")
+
+
+def test_auto_selection_lowers_tree_and_hierarchical_per_bucket():
+    """The ISSUE 10 acceptance bar: on an 8-device 2-slice topology,
+    ``auto`` lowers a small latency-bound bucket to the TREE form
+    (log2(8)=3 chained pair-group all-reduces) and a large bucket to the
+    hierarchical RS/AG ladder with node-local replica groups — in ONE
+    grouped program. Forcing ``flat`` collapses both buckets to plain
+    whole-world all-reduces with neither group structure, so the test
+    distinguishes the selections rather than passing vacuously."""
+    topo = _topo84()
+    small_elems, large_elems = 1024, 256 * 1024      # 4 KB vs 1 MB fp32
+    shapes = ((small_elems,), (large_elems,))
+    buckets = [[0], [1]]
+    algos = tuple(
+        C.choose_algorithm("allreduce", 4 * e, topo)
+        for e in (small_elems, large_elems))
+    assert algos == ("tree", "hierarchical"), algos
+    mesh = _world_mesh()
+    args = [jax.device_put(jnp.ones((8, e), jnp.float32),
+                           NamedSharding(mesh, P("world")))
+            for e in (small_elems, large_elems)]
+
+    auto_fn = C.build_grouped_allreduce(
+        mesh, "world", ReduceOp.SUM, shapes, [jnp.float32] * 2, buckets,
+        local_size=topo.local_size, algos=algos)
+    hlo = _hlo(auto_fn, *args).replace(" ", "")
+    # tree bucket: exactly 3 chained pair-group psums (dependent rounds
+    # the combiner cannot merge)
+    assert _count(r"all-reduce(?:-start)?\(", hlo) == 3, hlo[:400]
+    assert re.search(_PAIR_GROUPS, hlo), "tree pair groups missing"
+    # hierarchical bucket: the RS/AG ladder over node-local groups
+    assert re.search(_NODE_GROUPS, hlo), "node-local ladder groups missing"
+    assert (_count(r"reduce-scatter", hlo) >= 1
+            or _count(r"all-gather", hlo) >= 1)
+
+    flat_fn = C.build_grouped_allreduce(
+        mesh, "world", ReduceOp.SUM, shapes, [jnp.float32] * 2, buckets,
+        local_size=topo.local_size, algos=("flat", "flat"))
+    fhlo = _hlo(flat_fn, *args).replace(" ", "")
+    n_ar = _count(r"all-reduce(?:-start)?\(", fhlo)
+    assert 1 <= n_ar <= 2, f"flat should be whole-world all-reduce: {n_ar}"
+    assert not re.search(_PAIR_GROUPS, fhlo)
+    assert not re.search(_NODE_GROUPS, fhlo)
+    assert _count(r"reduce-scatter", fhlo) == 0
+
+    # same numbers either way (8 identical 'rank' contributions -> x8)
+    for a, b in zip(auto_fn(*args), flat_fn(*args)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+
+def test_tree_allreduce_builder_structure_and_values():
+    mesh = _world_mesh()
+    fn = C.build_tree_allreduce(mesh, "world", ReduceOp.SUM)
+    x = jax.device_put(jnp.arange(8 * 6, dtype=jnp.float32).reshape(8, 6),
+                       NamedSharding(mesh, P("world")))
+    hlo = _hlo(fn, x).replace(" ", "")
+    assert _count(r"all-reduce(?:-start)?\(", hlo) == 3
+    assert re.search(_PAIR_GROUPS, hlo)
+    out = np.asarray(fn(x))
+    np.testing.assert_allclose(
+        out, np.arange(8 * 6, dtype=np.float32).reshape(8, 6).sum(0))
+
+
+def test_replay_step_per_bucket_algo_segments():
+    """The replay segment's topology field carries per-bucket algorithms
+    (the (local_size, algos) tuple form): the armed program lowers its
+    small bucket to the tree and its large bucket to the ladder — so
+    warmup and steady state resolve the same topology-aware schedule."""
+    from jax.sharding import NamedSharding
+    mesh = _world_mesh()
+    shapes = ((64,), (4096,))
+    segments = (("reduce", int(ReduceOp.SUM), 1.0, 1.0,
+                 (4, ("tree", "hierarchical")), shapes, ((0,), (1,))),)
+    fn = C.build_replay_step(mesh, "world", segments, pipeline=True)
+    rep = NamedSharding(mesh, P())
+    args = [jax.device_put(jnp.ones(s, jnp.float32), rep) for s in shapes]
+    hlo = _hlo(fn, *args).replace(" ", "")
+    assert _count(r"all-reduce(?:-start)?\(", hlo) == 3  # the tree rounds
+    assert re.search(_PAIR_GROUPS, hlo)
+    assert re.search(_NODE_GROUPS, hlo)
+    outs = fn(*args)
+    np.testing.assert_allclose(np.asarray(outs[0]), 8.0 * np.ones((64,)))
+    np.testing.assert_allclose(np.asarray(outs[1]), 8.0 * np.ones((4096,)))
+    # legacy int field still means "one algorithm everywhere" (flat here)
+    legacy = C.build_replay_step(
+        mesh, "world",
+        (("reduce", int(ReduceOp.SUM), 1.0, 1.0, 0, shapes,
+          ((0,), (1,))),))
+    lhlo = _hlo(legacy, *args).replace(" ", "")
+    assert not re.search(_PAIR_GROUPS, lhlo)
+    assert not re.search(_NODE_GROUPS, lhlo)
+
+
+def test_sharded_step_hierarchical_ag_leg():
+    """ZeRO-1 with a hierarchical return all-gather: the reduce-scatter
+    leg stays the flat whole-world scatter (shard-ownership invariant)
+    while the gather lowers to the two-level ladder — and the result is
+    bitwise-identical to the flat-gather program."""
+    mesh = _world_mesh()
+    grad_shapes = tuple((6,) for _ in range(4))
+    buckets = [[0, 1], [2, 3]]
+    st_shapes = ((2,), (2,))
+
+    def update(shards, state):
+        return [s + m for s, m in zip(shards, state)], list(state)
+
+    kw = dict(pipeline=True)
+    hier = C.build_sharded_step(mesh, "world", ReduceOp.SUM, grad_shapes,
+                                [jnp.float32] * 4, buckets, st_shapes,
+                                None, update, local_size=4,
+                                ag_algos=("hierarchical", "hierarchical"),
+                                **kw)
+    flat = C.build_sharded_step(mesh, "world", ReduceOp.SUM, grad_shapes,
+                                [jnp.float32] * 4, buckets, st_shapes,
+                                None, update, **kw)
+    rng = np.random.RandomState(5)
+    packed = [jax.device_put(
+        jnp.asarray(rng.randn(8, 12).astype(np.float32)),
+        NamedSharding(mesh, P("world"))) for _ in buckets]
+    state = [jax.device_put(jnp.ones((2,), jnp.float32),
+                            NamedSharding(mesh, P())) for _ in range(2)]
+    hhlo = _hlo(hier, *packed, *state).replace(" ", "")
+    # whole-world scatters survive; gathers go node-local two-level
+    assert _count(r"reduce-scatter(?:-start)?\(", hhlo) >= 2
+    assert re.search(_NODE_GROUPS, hhlo), "no two-level gather groups"
+    for a, b in zip(hier(*packed, *state), flat(*packed, *state)):
+        np.testing.assert_array_equal(
+            np.asarray(a.addressable_shards[0].data),
+            np.asarray(b.addressable_shards[0].data))
+
+
 def test_grouped_allreduce_hierarchical_ladder():
     """The single-launch grouped program with local_size=4 must lower each
     bucket's reduction to the hierarchical RS/AG ladder with node-local

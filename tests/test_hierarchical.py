@@ -144,3 +144,68 @@ class TestHierarchicalAdasum:
         with pytest.raises(ValueError, match="power-of-2"):
             # 8 / 3 isn't even integral; simulate bad factorization directly
             hierarchical_adasum_p(jnp.zeros((4,)), "world", 3, 9)
+
+
+class TestNonDivisibleFallback:
+    """ISSUE 10 satellite: the old hard ``assert n % local_size == 0`` in
+    the hierarchical builders crashed non-divisible worlds (e.g. an
+    elastic job degraded from 8 to 6 ranks with local_size=4). Every
+    builder now demotes to the flat program with a one-time WARNING and
+    keeps producing exact results."""
+
+    def _mesh6(self):
+        devs = jax.devices()[:6]
+        return Mesh(np.array(devs), ("world",))
+
+    def test_allreduce_np6_local4_demotes_to_flat(self, caplog):
+        import logging
+        mesh = self._mesh6()
+        rng = np.random.RandomState(7)
+        x = rng.rand(6, 5).astype(np.float32)
+        garr = jax.device_put(jnp.asarray(x),
+                              NamedSharding(mesh, P("world")))
+        C._warned_demotions.clear()
+        with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+            hier = C.build_hierarchical_allreduce(mesh, "world", 4,
+                                                  ReduceOp.SUM)
+            out = np.asarray(hier(garr))
+        np.testing.assert_allclose(out, x.sum(axis=0), rtol=1e-5)
+        warnings = [r for r in caplog.records
+                    if "using flat" in r.getMessage()]
+        assert len(warnings) == 1
+        # one-time: a second build emits no further warning
+        with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+            before = len(caplog.records)
+            C.build_hierarchical_allreduce(mesh, "world", 4, ReduceOp.SUM)
+            assert not [r for r in caplog.records[before:]
+                        if "using flat" in r.getMessage()]
+
+    def test_allgather_np6_local4_demotes_to_flat(self):
+        mesh = self._mesh6()
+        rng = np.random.RandomState(8)
+        x = rng.rand(6, 2, 3).astype(np.float32)
+        garr = jax.device_put(jnp.asarray(x),
+                              NamedSharding(mesh, P("world")))
+        hier = C.build_hierarchical_allgather(mesh, "world", 4)
+        flat = C.build_allgather(mesh, "world")
+        np.testing.assert_array_equal(np.asarray(hier(garr)),
+                                      np.asarray(flat(garr)))
+
+    def test_fused_reduce_np6_local4_demotes_to_flat(self):
+        """The fused-bucket reducer closure (_make_reduce_flat, the third
+        old assert site) on the same non-divisible world."""
+        mesh = self._mesh6()
+        shapes = ((10,), (14,))
+        fn = C.build_grouped_allreduce(mesh, "world", ReduceOp.SUM,
+                                       shapes, [jnp.float32] * 2, [[0, 1]],
+                                       local_size=4)
+        rng = np.random.RandomState(9)
+        data = rng.rand(6, 24).astype(np.float32)
+        garr = jax.device_put(jnp.asarray(data),
+                              NamedSharding(mesh, P("world")))
+        outs = fn(garr)
+        expect = data.sum(axis=0)
+        np.testing.assert_allclose(np.asarray(outs[0]), expect[:10],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(outs[1]), expect[10:],
+                                   rtol=1e-5)

@@ -388,7 +388,10 @@ class TestLiveInstrumentation:
         kinds = {tuple(sorted(l.items()))
                  for l, _ in snap["counters"]["hvd_tpu_wire_bytes_total"]
                  ["values"]}
-        assert (("dtype", "float32"), ("kind", "allreduce")) in kinds
+        # every wire series carries the fabric-link label (ISSUE 10);
+        # a size-1 world moves everything over link="flat"
+        assert (("dtype", "float32"), ("kind", "allreduce"),
+                ("link", "flat")) in kinds
         # the sync allreduce retires through synchronize -> latency observed
         lat = snap["histograms"]["hvd_tpu_op_latency_seconds"]["values"]
         assert any(l.get("kind") == "allreduce" and ent["count"] >= 1

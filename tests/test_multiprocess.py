@@ -870,3 +870,107 @@ def test_np2_checkpoint_reshard_restore_parity(tmp_path):
             np.asarray(r0["w"], np.float32))
     finally:
         m.close(flush=False)
+
+
+def _worker_algo_parity():
+    """Force the collective-algorithm knob to every value IN-PROCESS (one
+    np=2 world, four forcings — the knob is re-read per call) and assert
+    every collective kind stays exact under each lowering. At np=2 the
+    forced 'hierarchical' has no non-trivial factorization and must
+    DEMOTE to flat (warning, never a crash) — the ISSUE 10 satellite's
+    degradation contract exercised on a real world."""
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+    rank, size = hvd.rank(), hvd.size()
+    eng = hvd._engine()
+    for algo in ("auto", "flat", "tree", "hierarchical"):
+        eng.config.collective_algo = algo
+        eng.replay.invalidate_all(f"force {algo}")
+        x = np.arange(8.0, dtype=np.float32) * (rank + 1)
+        out = np.asarray(hvd.allreduce(x, name=f"ar.{algo}", op=hvd.Sum))
+        np.testing.assert_allclose(out, np.arange(8.0) * 3.0, rtol=1e-6)
+        g0, g1 = hvd.grouped_allreduce([x, x + 1.0], name=f"g.{algo}",
+                                       op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(g0),
+                                   np.arange(8.0) * 3.0, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(g1),
+                                   np.arange(8.0) * 3.0 + 2.0, rtol=1e-6)
+        g = np.asarray(hvd.allgather(np.array([float(rank)]),
+                                     name=f"ag.{algo}"))
+        np.testing.assert_allclose(g, np.arange(float(size)))
+        rs = np.asarray(hvd.reducescatter(
+            np.ones((size, 3), np.float32) * (rank + 1),
+            name=f"rs.{algo}"))
+        np.testing.assert_allclose(rs, np.full((1, 3), 3.0))
+    snap = hvd.metrics_snapshot()
+    algos_seen = {
+        (l.get("kind"), l.get("algo"))
+        for l, _ in snap["counters"].get(
+            "hvd_tpu_collective_algo_total", {"values": []})["values"]}
+    links_seen = {
+        l.get("link")
+        for l, _ in snap["counters"]["hvd_tpu_wire_bytes_total"]["values"]}
+    return {"rank": rank, "algos": sorted(map(list, algos_seen)),
+            "links": sorted(links_seen)}
+
+
+@pytest.mark.integration
+def test_two_process_forced_algo_parity():
+    from horovod_tpu.runner import run
+    r0, r1 = run(_worker_algo_parity, np=2, env=_mp_env())
+    for r in (r0, r1):
+        algos = {tuple(a) for a in r["algos"]}
+        # forced tree really ran as tree; forced hierarchical demoted to
+        # flat at np=2 (no non-trivial factorization) — so no
+        # hierarchical selection may appear
+        assert ("allreduce", "tree") in algos, algos
+        assert ("allreduce", "flat") in algos, algos
+        assert not any(a == "hierarchical" for _, a in algos), algos
+        # every wire byte carries the fabric-link label
+        assert r["links"] == ["flat"], r["links"]
+
+
+def _worker_hetero_topology():
+    """Ranks 0-1 hold a LOCAL topology view that factorizes
+    (local_size=2), ranks 2-3 the flat launcher view (local_size=4 ==
+    world): auto selection of a large bucket must NOT deadlock on a
+    rank-divergent entry into the homogeneity exchange — every rank
+    enters it at the first selection, the non-uniform local sizes agree
+    on "no hierarchy", and everyone lowers flat (the code-review
+    deadlock regression for Engine._choose_algo; the divergent view is
+    installed on the live engine because hvd.init() runs before worker
+    bodies, exactly how a heterogeneous host assignment would diverge)."""
+    import dataclasses
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+    eng = hvd._engine()
+    if hvd.rank() < 2:
+        eng.topology = dataclasses.replace(eng.topology, local_size=2)
+        assert eng.topology.hierarchical_ok     # genuinely divergent view
+    eng._hier_ok = None                          # agreement not yet run
+    big = np.ones(128 * 1024, np.float32)    # 512 KB: past the tree band
+    out = np.asarray(hvd.allreduce(big, name="het", op=hvd.Sum))
+    np.testing.assert_allclose(out[:4], 4.0)
+    snap = hvd.metrics_snapshot()
+    algos = {
+        (l.get("kind"), l.get("algo"))
+        for l, _ in snap["counters"].get(
+            "hvd_tpu_collective_algo_total", {"values": []})["values"]}
+    return {"rank": hvd.rank(), "local": eng.topology.local_size,
+            "hier_ok": bool(eng._hierarchical_ok()),
+            "algos": sorted(map(list, algos))}
+
+
+@pytest.mark.integration
+def test_heterogeneous_topology_agrees_on_flat():
+    from horovod_tpu.runner import run
+    results = run(_worker_hetero_topology, np=4, env=_mp_env())
+    locals_seen = sorted(r["local"] for r in results)
+    assert locals_seen == [2, 2, 4, 4], locals_seen   # views really diverged
+    for r in results:
+        assert r["hier_ok"] is False, r                # uniform agreement
+        assert not any(a == "hierarchical" for _, a in map(tuple, r["algos"])), r

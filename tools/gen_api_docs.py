@@ -52,6 +52,13 @@ SECTIONS = [
         "build_grouped_reducescatter", "build_grouped_allgather",
         "build_sharded_step", "build_sharded_update", "build_replay_step",
         "shard_spec"]),
+    ("Topology & algorithm selection", "horovod_tpu.parallel.mesh", [
+        "Topology", "detect_topology", "world_mesh", "hierarchical_mesh",
+        "training_mesh", "multislice_mesh"]),
+    ("", "horovod_tpu.ops.collectives", [
+        "choose_algorithm", "validate_algorithm", "link_split",
+        "tree_groups", "build_tree_allreduce",
+        "build_hierarchical_allreduce", "build_hierarchical_allgather"]),
     ("Comm/compute overlap", "horovod_tpu.common.env", ["apply_xla_lhs"]),
     ("Reduce ops & exceptions", "horovod_tpu", [
         "ReduceOp", "HorovodInternalError", "HostsUpdatedInterrupt",

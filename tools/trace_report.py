@@ -98,6 +98,30 @@ def skew_by_kind(skews: Dict[str, dict]) -> Dict[str, dict]:
     return out
 
 
+def wire_by_link(events: List[dict]) -> Dict[str, dict]:
+    """Per-kind cluster wire bytes by fabric link (ISSUE 10), summed from
+    the ``link_bytes`` split the engine stamps on enqueue (B) events:
+    ``kind -> {"ici"/"dcn"/"flat": bytes}``. Hierarchical legs surface as
+    separate ici/dcn rows — the observable face of the 1/local_size
+    cross-slice traffic reduction; traces from older runs (no stamps)
+    yield an empty table."""
+    out: Dict[str, dict] = {}
+    for ev in events:
+        if ev.get("ph") != "B":
+            continue
+        args = ev.get("args")
+        lb = args.get("link_bytes") if isinstance(args, dict) else None
+        if not isinstance(lb, dict):
+            continue
+        ent = out.setdefault(str(ev.get("name", "")), {})
+        for link, b in lb.items():
+            try:
+                ent[str(link)] = ent.get(str(link), 0) + int(b)
+            except (TypeError, ValueError):
+                continue
+    return out
+
+
 def straggler_ranking(skews: Dict[str, dict]) -> List[dict]:
     """Ranks ordered by how often they arrived last (ties by total
     lateness): ``[{rank, last_count, total_late_us, mean_late_us}]``."""
@@ -232,12 +256,18 @@ def analyze(events: List[dict]) -> dict:
     notebooks call this directly)."""
     skews = arrival_skew(events)
     ranking = straggler_ranking(skews)
+    by_kind = skew_by_kind(skews)
+    links = wire_by_link(events)
+    for kind, ent in by_kind.items():
+        if kind in links:
+            ent["wire_bytes_by_link"] = links[kind]
     return {
         "events": len(events),
         "ranks": sorted({int(e.get("pid", 0)) for e in events
                          if e.get("ph") in ("B", "E", "X")}),
         "correlated_collectives": len(skews),
-        "skew_by_kind": skew_by_kind(skews),
+        "skew_by_kind": by_kind,
+        "wire_by_link": links,
         "stragglers": ranking,
         "top_straggler": ranking[0]["rank"] if ranking else None,
         "wire_vs_gap": wire_vs_gap(events),
@@ -352,10 +382,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     if rep["skew_by_kind"]:
         print("\narrival skew by kind (first-arrival vs last-arrival rank):")
         for kind, s in sorted(rep["skew_by_kind"].items()):
+            links = s.get("wire_bytes_by_link")
+            tail = ("  wire[" + " ".join(
+                f"{k}={v}" for k, v in sorted(links.items())) + "]"
+                if links else "")
             print(f"  {kind:<22} n={s['count']:<5} "
                   f"mean={_fmt_us(s['mean_us']):<10} "
                   f"p50={_fmt_us(s['p50_us']):<10} "
-                  f"max={_fmt_us(s['max_us'])}")
+                  f"max={_fmt_us(s['max_us'])}{tail}")
+    if rep["wire_by_link"]:
+        print("\nwire bytes by fabric link (cluster total, per kind):")
+        for kind, links in sorted(rep["wire_by_link"].items()):
+            row = "  ".join(f"{k}={v}" for k, v in sorted(links.items()))
+            print(f"  {kind:<22} {row}")
     if rep["stragglers"]:
         print(f"\ntop stragglers (of {rep['correlated_collectives']} "
               f"correlated collectives):")
