@@ -14,19 +14,33 @@ this package extends it from *names* to *behavior*:
   inversion, blocking call made under a lock, and thread target touching
   unannotated shared state. Suppressions are inline
   (``# lockcheck: ignore[reason]``), counted, and must carry a reason.
+- :mod:`.divcheck` — the SPMD divergence & dispatch-determinism checker
+  (ISSUE 11): a cross-file call-graph pass enforcing the
+  lockstep-submission invariant the runtime's deleted-coordinator design
+  rests on — no collective gated on rank-local state, no collective
+  submitted in unordered iteration, no rank-local value steering a
+  collectively-identical decision without a ``# divcheck: agreed[how]``
+  exchange point, and no env/host reads on the step path after engine
+  init.
 - :mod:`.knobcheck` — the configuration-knob registry lint: every
   ``HOROVOD_*`` environment variable read under ``horovod_tpu/`` must be
   declared in :data:`horovod_tpu.common.knobs.KNOB_SPECS` (and every
-  declared knob must actually be read somewhere).
+  declared knob must actually be read somewhere), declared defaults must
+  be consistent with their types/choices, and choice knobs must be read
+  through the registry parser.
 
-Both are pure-stdlib AST passes (no runtime import of the modules they
+All are pure-stdlib AST passes (no runtime import of the modules they
 scan). ``tools/check.py`` is the unified driver that runs them next to
-the metric-name, fault-name, and trace-schema lints as one command with
-one machine-readable report; see ``docs/static_analysis.md``.
+the metric-name, fault-name, trace-schema, and checkpoint-manifest
+lints as one command with one machine-readable report; see
+``docs/static_analysis.md``.
 """
 
+import ast
+import io
 import os
-from typing import Iterator
+import tokenize
+from typing import Dict, Iterator, Optional, Tuple
 
 
 def iter_py_files(root: str) -> Iterator[str]:
@@ -39,3 +53,45 @@ def iter_py_files(root: str) -> Iterator[str]:
         for name in sorted(names):
             if name.endswith(".py"):
                 yield os.path.join(dirpath, name)
+
+
+def comments_by_line(source: str) -> Dict[int, Tuple[str, bool]]:
+    """line -> (comment text, standalone) for one module's source —
+    the one comment harvester lockcheck and divcheck share, so the
+    annotation grammars cannot drift. ``standalone`` means the comment
+    is the only thing on its line: only those may also cover the line
+    directly BELOW them (a trailing comment must never bleed onto the
+    next line's findings)."""
+    out: Dict[int, Tuple[str, bool]] = {}
+    lines = source.splitlines()
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                lineno = tok.start[0]
+                text = lines[lineno - 1] if lineno <= len(lines) else ""
+                standalone = text.lstrip().startswith("#")
+                out[lineno] = (tok.string.lstrip("#").strip(), standalone)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return out
+
+
+def parse_tag(comment: str, tag: str) -> Optional[str]:
+    """``<tag>[payload]`` -> payload (``''`` when the brackets are
+    missing or empty; ``None`` when the tag is absent) — the shared
+    grammar behind ``lockcheck: ignore[...]``, ``divcheck: ignore[...]``
+    and ``divcheck: agreed[...]``."""
+    idx = comment.find(tag)
+    if idx < 0:
+        return None
+    rest = comment[idx + len(tag):].strip()
+    if rest.startswith("[") and "]" in rest:
+        return rest[1:rest.index("]")].strip()
+    return ""
+
+
+def is_environ(node: ast.expr) -> bool:
+    """``os.environ`` / bare ``environ`` / ``_os.environ`` — the shared
+    receiver predicate behind every env-read scan."""
+    return (isinstance(node, ast.Attribute) and node.attr == "environ") or \
+        (isinstance(node, ast.Name) and node.id == "environ")

@@ -1,7 +1,12 @@
 """Configuration-knob registry lint: every ``HOROVOD_*`` environment
 variable read under ``horovod_tpu/`` must be declared in
-:data:`horovod_tpu.common.knobs.KNOB_SPECS`, and every declared knob must
-actually be read somewhere (no dead knobs).
+:data:`horovod_tpu.common.knobs.KNOB_SPECS`, every declared knob must
+actually be read somewhere (no dead knobs), every declared default must
+be consistent with its declared type/choices, and declared-``choice``
+knobs must be read through the registry parser (``_get_choice``), never
+re-parsed ad hoc (ISSUE 11 satellite: ad-hoc parses drift — the tree's
+one offender had grown two different defaults and a wider accepted
+token set than the registry declared).
 
 The scan is a pure-AST pass (no module under scan is imported). A "read"
 is the first argument of:
@@ -14,7 +19,9 @@ is the first argument of:
 where the argument is a string literal or a name/attribute resolvable
 through the constants table in ``horovod_tpu/common/env.py`` (the
 ``HOROVOD_X = "HOROVOD_X"`` block). Arguments that stay symbolic (e.g.
-the ``name`` parameter inside the helpers themselves) are ignored.
+the ``name`` parameter inside the helpers themselves) are ignored. Each
+site records the reader form so the choice-knob discipline can tell a
+``_get_choice`` read from a raw ``environ.get``.
 
 ``tools/check.py`` runs this next to the other lints;
 ``tools/gen_api_docs.py`` renders the registry as the generated
@@ -27,6 +34,8 @@ import ast
 import os
 import re
 from typing import Dict, List, Optional, Tuple
+
+from . import is_environ as _is_environ  # shared receiver predicate
 
 KNOB_NAME_RE = re.compile(r"^HOROVOD(_TPU)?(_[A-Z0-9]+)+$")
 VALID_TYPES = ("bool", "int", "float", "str", "choice", "spec")
@@ -60,31 +69,35 @@ def _resolve(arg: ast.expr, consts: Dict[str, str]) -> Optional[str]:
     return None
 
 
-def _is_environ(node: ast.expr) -> bool:
-    """``os.environ`` / bare ``environ`` / ``_os.environ``."""
-    return (isinstance(node, ast.Attribute) and node.attr == "environ") or \
-        (isinstance(node, ast.Name) and node.id == "environ")
 
 
 def scan_env_reads(pkg_root: str,
                    errors: Optional[List[str]] = None
-                   ) -> List[Tuple[str, int, str]]:
+                   ) -> List[Tuple[str, int, str, str]]:
     """Every resolvable env-var read under ``pkg_root``:
-    (relpath, lineno, var name). Only ``HOROVOD*`` names are returned.
-    Files that fail to parse are reported into ``errors`` (when given)
-    instead of silently dropping their read sites — a skipped file would
-    turn an undeclared read invisible and a declared one "dead"."""
+    (relpath, lineno, var name, reader form). The reader form is the
+    call that performed the read (``environ.get`` / ``getenv`` /
+    ``_get_bool`` / ... / ``subscript``) so the choice-knob discipline
+    can tell the registry parser apart from an ad-hoc parse. Only
+    ``HOROVOD*`` names are returned. Files that fail to parse are
+    reported into ``errors`` (when given) instead of silently dropping
+    their read sites — a skipped file would turn an undeclared read
+    invisible and a declared one "dead"."""
     consts = _const_table(os.path.join(pkg_root, "common", "env.py"))
-    sites: List[Tuple[str, int, str]] = []
+    sites: List[Tuple[str, int, str, str]] = []
+    # paths are reported relative to the package's PARENT (repo root for
+    # the live tree: "horovod_tpu/faults.py"), matching lockcheck/
+    # divcheck so path:line findings anchor in --format=github
+    rel_root = os.path.dirname(os.path.abspath(pkg_root))
 
-    def note(rel: str, node: ast.AST, arg: ast.expr):
+    def note(rel: str, node: ast.AST, arg: ast.expr, reader: str):
         name = _resolve(arg, consts)
         if name and name.startswith("HOROVOD"):
-            sites.append((rel, node.lineno, name))
+            sites.append((rel, node.lineno, name, reader))
 
     from . import iter_py_files
     for path in iter_py_files(pkg_root):
-        rel = os.path.relpath(path, pkg_root)
+        rel = os.path.relpath(path, rel_root)
         with open(path, encoding="utf-8", errors="replace") as f:
             try:
                 tree = ast.parse(f.read())
@@ -105,19 +118,21 @@ def scan_env_reads(pkg_root: str,
                          (func.attr == "getenv" and
                           isinstance(func.value, ast.Name))):
                     if node.args:
-                        note(rel, node, node.args[0])
+                        note(rel, node, node.args[0],
+                             "getenv" if func.attr == "getenv"
+                             else f"environ.{func.attr}")
                 elif isinstance(func, ast.Name) and \
                         func.id in ("getenv",) + _READ_HELPERS:
                     if node.args:
-                        note(rel, node, node.args[0])
+                        note(rel, node, node.args[0], func.id)
                 elif isinstance(func, ast.Attribute) and \
                         func.attr in _READ_HELPERS:
                     if node.args:
-                        note(rel, node, node.args[0])
+                        note(rel, node, node.args[0], func.attr)
             elif isinstance(node, ast.Subscript) and \
                     isinstance(node.ctx, ast.Load) and \
                     _is_environ(node.value):
-                note(rel, node, node.slice)
+                note(rel, node, node.slice, "subscript")
     return sites
 
 
@@ -143,16 +158,84 @@ def validate_specs(specs: Dict[str, dict]) -> List[str]:
     return errors
 
 
+def validate_defaults(specs: Dict[str, dict]) -> List[str]:
+    """Declared defaults must be consistent with the declared type and
+    choices (ISSUE 11 satellite): a choice default outside its own
+    choices, or an int default that parses as nothing, is registry rot
+    waiting to become a runtime surprise. ``default`` is a *display*
+    string, so the typed checks accept the documented display forms:
+    empty (launcher-set), ``derived``, and a leading numeric token with
+    a parenthesized qualifier (``"100 (10 when elastic)"``)."""
+    errors = []
+    _BOOLISH = ("0", "1", "true", "false", "yes", "no", "on", "off", "")
+    for name, spec in sorted(specs.items()):
+        if not isinstance(spec, dict):
+            continue  # shape error already reported by validate_specs
+        default = spec.get("default")
+        if not isinstance(default, str):
+            errors.append(f"{name}: default must be a display string, "
+                          f"got {type(default).__name__}")
+            continue
+        ktype = spec.get("type")
+        if ktype == "choice":
+            choices = spec.get("choices") or ()
+            bad = [c for c in choices if not isinstance(c, str)]
+            if bad:
+                errors.append(f"{name}: choices must be strings "
+                              f"(got {bad})")
+            elif choices and default not in choices:
+                errors.append(
+                    f"{name}: default {default!r} is not one of its own "
+                    f"choices {tuple(choices)}")
+        elif ktype == "bool":
+            if default.strip().lower() not in _BOOLISH:
+                errors.append(f"{name}: bool default {default!r} is not "
+                              f"a recognized boolean token")
+        elif ktype in ("int", "float"):
+            tok = default.strip().split(" ")[0] if default.strip() else ""
+            if tok in ("", "derived"):
+                continue
+            try:
+                int(tok) if ktype == "int" else float(tok)
+            except ValueError:
+                errors.append(f"{name}: {ktype} default {default!r} does "
+                              f"not parse (leading token {tok!r})")
+    return errors
+
+
+def validate_choice_reads(specs: Dict[str, dict],
+                          sites: List[Tuple[str, int, str, str]]
+                          ) -> List[str]:
+    """Declared-``choice`` knobs must be read through ``_get_choice``
+    (the registry parser: one accepted-token set, one warn-and-default
+    path) — a raw ``environ.get`` re-parse is exactly how accepted
+    values drift away from the declared choices."""
+    errors = []
+    choice_knobs = {n for n, s in specs.items()
+                    if isinstance(s, dict) and s.get("type") == "choice"}
+    for site in sites:
+        rel, lineno, name = site[0], site[1], site[2]
+        reader = site[3] if len(site) > 3 else "?"
+        if name in choice_knobs and reader != "_get_choice":
+            errors.append(
+                f"{rel}:{lineno}: choice knob {name!r} is read via "
+                f"{reader} instead of the registry parser _get_choice "
+                f"(declared choices: "
+                f"{tuple(specs[name].get('choices') or ())})")
+    return errors
+
+
 def validate_reads(specs: Dict[str, dict],
-                   sites: List[Tuple[str, int, str]]) -> List[str]:
+                   sites: List[Tuple[str, int, str, str]]) -> List[str]:
     """Undeclared reads + dead (declared-but-unread) knobs."""
     errors = []
-    for rel, lineno, name in sites:
+    for site in sites:
+        rel, lineno, name = site[0], site[1], site[2]
         if name not in specs:
             errors.append(
                 f"{rel}:{lineno}: env var {name!r} is read but not "
                 f"declared in horovod_tpu.common.knobs.KNOB_SPECS")
-    read = {name for _, _, name in sites}
+    read = {site[2] for site in sites}
     # export-only knobs are part of the worker env contract: the framework
     # sets them for subprocesses but never reads them back
     declared = {n for n, s in specs.items()
@@ -173,7 +256,12 @@ def run(pkg_root: Optional[str] = None) -> Tuple[List[str], dict]:
     errors: List[str] = []
     sites = scan_env_reads(pkg_root, errors=errors)
     errors += validate_specs(KNOB_SPECS)
+    errors += validate_defaults(KNOB_SPECS)
     errors += validate_reads(KNOB_SPECS, sites)
+    errors += validate_choice_reads(KNOB_SPECS, sites)
     stats = {"declared": len(KNOB_SPECS), "read_sites": len(sites),
-             "distinct_read": len({n for _, _, n in sites})}
+             "distinct_read": len({site[2] for site in sites}),
+             "choice_knobs": sum(
+                 1 for s in KNOB_SPECS.values()
+                 if isinstance(s, dict) and s.get("type") == "choice")}
     return errors, stats

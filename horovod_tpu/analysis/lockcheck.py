@@ -59,12 +59,13 @@ Pure stdlib; no module under scan is imported.
 from __future__ import annotations
 
 import ast
-import io
 import json
 import os
-import tokenize
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
+
+from . import comments_by_line as _comments_by_line
+from . import parse_tag as _parse_tag
 
 # threading/queue constructors recognized when classifying attributes
 # assigned in methods (``self.x = threading.Lock()`` ...)
@@ -146,37 +147,13 @@ class Report:
 
 
 # ---------------------------------------------------------------------------
-# comment harvesting
+# comment harvesting (the harvester and tag grammar are shared with
+# divcheck — horovod_tpu.analysis.comments_by_line / parse_tag)
 # ---------------------------------------------------------------------------
-
-def _comments_by_line(source: str) -> Dict[int, Tuple[str, bool]]:
-    """line -> (comment text, standalone). ``standalone`` means the
-    comment is the only thing on its line — only those may suppress the
-    line BELOW them (a trailing comment must never bleed onto the next
-    line's findings)."""
-    out: Dict[int, Tuple[str, bool]] = {}
-    lines = source.splitlines()
-    try:
-        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
-            if tok.type == tokenize.COMMENT:
-                lineno = tok.start[0]
-                text = lines[lineno - 1] if lineno <= len(lines) else ""
-                standalone = text.lstrip().startswith("#")
-                out[lineno] = (tok.string.lstrip("#").strip(), standalone)
-    except (tokenize.TokenError, IndentationError, SyntaxError):
-        pass
-    return out
-
 
 def _parse_ignore(comment: str) -> Optional[str]:
     """``lockcheck: ignore[reason]`` -> reason ('' when missing)."""
-    idx = comment.find(_IGNORE_TAG)
-    if idx < 0:
-        return None
-    rest = comment[idx + len(_IGNORE_TAG):].strip()
-    if rest.startswith("[") and "]" in rest:
-        return rest[1:rest.index("]")].strip()
-    return ""
+    return _parse_tag(comment, _IGNORE_TAG)
 
 
 # ---------------------------------------------------------------------------

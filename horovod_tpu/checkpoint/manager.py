@@ -98,10 +98,16 @@ class CheckpointManager:
     merged cluster trace shows the checkpoint timeline.
     """
 
-    # lock discipline (tools/check.py lockcheck): the step path stamps
-    # requests while the worker thread drains them; the tiny state
-    # machine rides one condition variable (its lock). All I/O
-    # (device_get, files, KV) is off-lock on the worker thread.
+    # lock discipline (tools/check.py lockcheck, ISSUE 11 checkpoint
+    # sweep): the step path stamps requests while the worker thread
+    # drains them; the tiny state machine rides one condition variable
+    # (its lock). All I/O (device_get, files, KV) is off-lock on the
+    # worker thread. Deliberately NOT lock-guarded: ``_provider`` and
+    # ``interval_steps`` are single-writer wiring attrs — GlobalState
+    # assigns them once, before the first step can call on_step, and
+    # the worker thread never touches them (the thread-share pass
+    # verifies that footprint); everything else on the instance is a
+    # construction-time constant (a fresh manager is built per world).
     _GUARDED_BY = {
         "_pending": "_cond",
         "_writing": "_cond",

@@ -275,10 +275,14 @@ KNOB_SPECS: Dict[str, dict] = {
                 "for good."},
     # -- attention / Pallas kernels -----------------------------------------
     "HOROVOD_SPLASH": {
-        "type": "choice", "default": "1", "choices": ("0", "1", "force"),
+        "type": "choice", "default": "1",
+        "choices": ("0", "1", "force", "true", "false", "yes", "no",
+                    "on", "off"),
         "help": "Splash-attention kernel for local attention: 0 off, 1 "
                 "auto (falls back off-TPU), force (raise when "
-                "unavailable)."},
+                "unavailable); boolean aliases accepted in both "
+                "directions, unknown tokens warn and take the "
+                "default."},
     "HOROVOD_SPLASH_VMEM_LIMIT": {
         "type": "int", "default": str(16 * 1024 * 1024),
         "help": "Scoped VMEM budget (bytes) the splash kernel compiles "

@@ -399,6 +399,15 @@ class Engine:
                                         devices=group_devs)
         self._algo_base = (config.collective_algo
                            if config.collective_algo != "flat" else "auto")
+        # Pallas fusion-pack knob resolved ONCE here (divcheck
+        # capture-impure-read fix): the per-call env read on the grouped
+        # dispatch path let a mid-run HOROVOD_PALLAS_PACK flip switch the
+        # launch structure between two otherwise-identical steps — under
+        # an armed replay stream some calls would diverge from the stream
+        # they were captured from. Knobs resolve at init; live retuning
+        # stays with the broadcast-synced autotune categorical.
+        from ..ops.pallas_kernels import pack_pallas_enabled
+        self._pack_pallas_base = pack_pallas_enabled()
         self._m_algo = _reg.counter("hvd_tpu_collective_algo_total")
         self._zero1_prefetch: Dict[tuple, dict] = {}
         self._in_step_bracket = False
@@ -702,6 +711,7 @@ class Engine:
         the replay invalidation guard live even for an engine object that
         survives a re-rendezvous. The attribute only moves forward (tests
         may bump it directly)."""
+        # divcheck: ignore[this re-read IS the replay re-arm edge: the rendezvous stamps the bump before any rank re-enters a step, and the value only moves forward]
         v = os.environ.get("HOROVOD_TPU_WORLD_VERSION")
         if v:
             try:
@@ -1329,11 +1339,11 @@ class Engine:
         self._m_buckets_obs(tensors, buckets)
         mesh = self.backend.group_mesh
         hier_local = self.topology.local_size
-        from ..ops.pallas_kernels import pack_pallas, pack_pallas_enabled
+        from ..ops.pallas_kernels import pack_pallas
         pm = self.parameter_manager
         use_pallas_pack = (pm.categorical_value("pallas_pack")
                            if pm is not None and pm.tunes("pallas_pack")
-                           else pack_pallas_enabled())
+                           else self._pack_pallas_base)
         results: Dict[int, jax.Array] = {}
         if not use_pallas_pack and self.config.single_launch:
             # TWO launches for the whole group (VERDICT r4 weak #1):

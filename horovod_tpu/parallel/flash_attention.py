@@ -23,7 +23,29 @@ import os
 
 import jax
 
+from ..common.env import _get_choice
 from .ring_attention import local_attention
+
+
+def _splash_mode() -> str:
+    """The HOROVOD_SPLASH choice, normalized to "0" / "1" / "force",
+    through the registry parser (ISSUE 11 knobcheck: declared-choice
+    knobs must not be re-parsed ad hoc — the two raw reads here had
+    already drifted to different defaults and accepted-token sets).
+    The declared choices keep every historically-working token: the
+    boolean aliases stay valid in BOTH directions, so a deliberate
+    ``HOROVOD_SPLASH=off`` keeps disabling the kernel. Two edges
+    deliberately follow the framework-wide ``_get_choice`` discipline
+    instead of the old ad-hoc parse: genuinely unknown tokens warn
+    loudly and take the default (instead of silently disabling), and a
+    set-but-EMPTY value means "unset" (default, enabled) like every
+    other knob in the registry — not a silent disable."""
+    from ..common.knobs import KNOB_SPECS
+    spec = KNOB_SPECS["HOROVOD_SPLASH"]
+    v = _get_choice("HOROVOD_SPLASH", spec["default"], spec["choices"])
+    if v == "force":
+        return "force"
+    return "1" if v in ("1", "true", "yes", "on") else "0"
 
 
 def flash_available() -> bool:
@@ -43,12 +65,9 @@ def splash_available() -> bool:
     vs ~11.5 ms); the whole-step difference is a few percent and inside
     the shared-chip run-to-run noise — bench_kernels.py re-measures live.
     """
-    # default-on knob: only the known truthy tokens enable it, so a typo'd
-    # attempt to disable ("f", "disable", ...) fails safe to disabled.
-    # "force" additionally overrides the automatic under-remat degrade
-    # (see _select_kernel).
-    if os.environ.get("HOROVOD_SPLASH", "1").strip().lower() not in (
-            "1", "true", "yes", "on", "force"):
+    # default-on choice knob ("force" additionally overrides the
+    # automatic under-remat degrade — see _select_kernel)
+    if _splash_mode() == "0":
         return False
     if jax.default_backend() != "tpu":
         return False
@@ -112,7 +131,7 @@ def _select_kernel(t: int, d: int, under_remat: bool,
     inputs double the streamed-slab residency)."""
     if not under_remat:
         return "splash"
-    if os.environ.get("HOROVOD_SPLASH", "").strip().lower() == "force":
+    if _splash_mode() == "force":
         return "splash"
     if _splash_remat_vmem_bytes(t, d, _splash_bkv(t),
                                 itemsize) > _scoped_vmem_bytes():

@@ -1,0 +1,25 @@
+"""divcheck fixture: impure reads on the step path (capture hazards)."""
+import os
+
+from horovod_tpu.ops.collectives import build_grouped_allreduce
+
+
+class MiniEngine:
+    def __init__(self):
+        # init-phase exemption: resolving knobs at construction is the
+        # sanctioned pattern — this read must NOT be a finding
+        self.threshold = int(os.environ.get("MY_THRESHOLD", "1024"))
+
+    def allreduce(self, tensors):
+        live = os.environ.get("MY_LIVE_KNOB")  # VIOLATION: env read on step path
+        self._stage(tensors)
+        return build_grouped_allreduce(tensors, live)
+
+    def _stage(self, tensors):
+        for f in os.listdir("/tmp"):  # VIOLATION: host I/O on step path
+            tensors.append(f)
+
+
+def off_path_read():
+    # not reachable from any step-path root: reading env here is fine
+    return os.environ.get("MY_OFFLINE_KNOB")

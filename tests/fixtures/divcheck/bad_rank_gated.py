@@ -1,0 +1,34 @@
+"""divcheck fixture: rank-gated collectives — the classic SPMD deadlock."""
+import os
+
+import horovod_tpu as hvd
+
+
+def direct_gate(grads):
+    if hvd.rank() == 0:
+        hvd.allreduce(grads, name="g")  # VIOLATION: if-gated collective
+    return grads
+
+
+def guard_return_gate(eng, grads):
+    if eng.backend.local_rank() != 0:
+        return grads
+    return eng.grouped_allreduce(grads)  # VIOLATION: guard-return gated
+
+
+class Elastic:
+    def __init__(self):
+        self.world_version = 0
+
+    def maybe_sync(self, eng, observed):
+        if observed != self.world_version:
+            eng.barrier()  # VIOLATION: world-version gated
+        return observed
+
+
+def else_branch_gate(eng, x, rank):
+    if rank == 0:
+        prep = x * 2
+    else:
+        prep = eng.broadcast(x, 0)  # VIOLATION: else-arm gated
+    return prep

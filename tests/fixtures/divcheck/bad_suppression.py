@@ -1,0 +1,25 @@
+"""divcheck fixture: suppression/annotation hygiene."""
+import horovod_tpu as hvd
+
+
+def reasonless(grads, rank):
+    if rank == 0:
+        return hvd.allreduce(grads)  # divcheck: ignore
+    return grads
+
+
+def stale():
+    # divcheck: ignore[old excuse for code that changed]
+    return 1
+
+
+def agreed_without_how(grads, rank):
+    if rank == 0:  # divcheck: agreed[]
+        return hvd.allreduce(grads)
+    return grads
+
+
+def stale_agreed(grads):
+    if len(grads) > 2:  # divcheck: agreed[nothing here is rank-local]
+        return hvd.allreduce(grads)
+    return grads

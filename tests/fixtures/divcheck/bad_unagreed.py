@@ -1,0 +1,24 @@
+"""divcheck fixture: rank-local values flowing into collective decisions."""
+import os
+import time
+
+from horovod_tpu.ops.collectives import bucket_by_size, choose_algorithm
+
+
+def env_into_selection(kind, nbytes, topo):
+    return choose_algorithm(kind, nbytes, topo,  # VIOLATION: env into selection
+                            force=os.environ.get("MY_ALGO"))
+
+
+def tainted_threshold(tensors):
+    threshold = int(os.environ.get("MY_THRESHOLD", "1024"))  # tainted here
+    return bucket_by_size(tensors, threshold)  # VIOLATION: tainted name into sink
+
+
+def time_into_layout(tensors):
+    return bucket_by_size(tensors, int(time.monotonic()))  # VIOLATION: time into sink
+
+
+def agreed_is_fine(tensors):
+    threshold = int(os.environ.get("MY_THRESHOLD", "1024"))  # divcheck: agreed[launcher exports one env to every rank before spawn]
+    return bucket_by_size(tensors, threshold)

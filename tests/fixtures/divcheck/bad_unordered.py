@@ -1,0 +1,35 @@
+"""divcheck fixture: collectives submitted in nondeterministic order."""
+import os
+
+import horovod_tpu as hvd
+
+
+def over_set(named_grads):
+    handles = {}
+    for name in set(named_grads):
+        handles[name] = hvd.allreduce(named_grads[name], name=name)  # VIOLATION: set iteration
+    return handles
+
+
+def over_listdir(eng, directory):
+    out = []
+    for fn in os.listdir(directory):
+        out.append(eng.broadcast_object(fn))  # VIOLATION: listdir iteration
+    return out
+
+
+class Tracker:
+    def __init__(self):
+        self._dirty = set()
+
+    def flush(self, eng):
+        for name in self._dirty:
+            eng.allreduce(name)  # VIOLATION: set attribute iteration
+        self._dirty = set()
+
+
+def sorted_is_fine(eng, directory):
+    out = []
+    for fn in sorted(os.listdir(directory)):
+        out.append(eng.broadcast_object(fn))
+    return out

@@ -1,0 +1,42 @@
+"""divcheck fixture: lockstep-correct code — zero findings expected."""
+import os
+
+import horovod_tpu as hvd
+
+
+def data_prep_gate(eng, x, root_rank):
+    # rank-gated DATA PREP with the collective outside the branch: the
+    # canonical broadcast_object shape, and not a finding
+    if eng.backend.rank() == root_rank:
+        payload = x * 2
+    else:
+        payload = x * 0
+    return eng.broadcast(payload, root_rank)
+
+
+def size_gate_is_agreed(eng, grads):
+    # world size is collectively identical — gating on it is lockstep
+    if eng.backend.size() == 1:
+        return grads
+    return eng.grouped_allreduce(grads)
+
+
+def ordered_iteration(eng, directory, named):
+    out = [eng.broadcast_object(f) for f in sorted(os.listdir(directory))]
+    for name in named:  # a list: submission order is the program order
+        out.append(hvd.allreduce(named[name], name=name))
+    return out
+
+
+class Warmup:
+    def __init__(self):
+        # init-phase knob resolution: the sanctioned pattern
+        self.threshold = int(os.environ.get("MY_THRESHOLD", "1024"))
+        self.world_version = 0
+
+    def agreed_condition(self, eng, observed):
+        # divcheck: agreed[bumps are rendezvous-stamped before any rank re-enters a step]
+        if observed != self.world_version:
+            eng.barrier()
+            self.world_version = observed
+        return observed

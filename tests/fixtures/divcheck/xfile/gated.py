@@ -1,0 +1,9 @@
+"""divcheck cross-file fixture: the rank gate lives here — only the
+cross-file call graph connects it to the collective in helper.py."""
+from .helper import sync_gradients
+
+
+def maybe_sync(grads, rank):
+    if rank == 0:
+        return sync_gradients(grads)  # VIOLATION: cross-file rank gate
+    return grads

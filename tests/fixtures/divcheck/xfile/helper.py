@@ -1,0 +1,6 @@
+"""divcheck cross-file fixture: the collective lives here."""
+import horovod_tpu as hvd
+
+
+def sync_gradients(grads):
+    return [hvd.allreduce(g, name=f"g.{i}") for i, g in enumerate(grads)]

@@ -1,0 +1,268 @@
+"""The SPMD divergence checker (ISSUE 11 tentpole): every finding class
+must be detected with file:line on the known fixtures, the clean fixture
+must produce zero findings, and the live ``horovod_tpu/`` tree must be
+clean with every suppression and agreed site carrying its reason.
+"""
+
+import os
+
+import pytest
+
+from horovod_tpu.analysis import divcheck
+
+pytestmark = pytest.mark.lint
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "divcheck")
+PKG_ROOT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "horovod_tpu")
+
+
+def _check_fixture(name):
+    path = os.path.join(FIXTURES, name)
+    rep = divcheck.check_paths([path], root=FIXTURES)
+    lines = []
+    if os.path.isfile(path):
+        lines = open(path).read().splitlines()
+    return rep, lines
+
+
+def _line_of(lines, needle, nth=0):
+    hits = [i + 1 for i, l in enumerate(lines) if needle in l]
+    assert hits, f"fixture drifted: {needle!r} not found"
+    return hits[nth]
+
+
+class TestViolationClasses:
+    def test_rank_gated_collective(self):
+        rep, lines = _check_fixture("bad_rank_gated.py")
+        got = {(f.check, f.line) for f in rep.findings}
+        for marker in ("VIOLATION: if-gated collective",
+                       "VIOLATION: guard-return gated",
+                       "VIOLATION: world-version gated",
+                       "VIOLATION: else-arm gated"):
+            assert ("rank-gated-collective",
+                    _line_of(lines, marker)) in got, marker
+        assert len(rep.findings) == 4
+
+    def test_nondeterministic_submission_order(self):
+        rep, lines = _check_fixture("bad_unordered.py")
+        got = {(f.check, f.line) for f in rep.findings}
+        for marker in ("VIOLATION: set iteration",
+                       "VIOLATION: listdir iteration",
+                       "VIOLATION: set attribute iteration"):
+            assert ("nondeterministic-submission-order",
+                    _line_of(lines, marker)) in got, marker
+        # sorted(os.listdir(...)) is deterministic — not a finding
+        assert len(rep.findings) == 3
+
+    def test_unagreed_selection_input(self):
+        rep, lines = _check_fixture("bad_unagreed.py")
+        got = {(f.check, f.line) for f in rep.findings}
+        for marker in ("VIOLATION: env into selection",
+                       "VIOLATION: tainted name into sink",
+                       "VIOLATION: time into sink"):
+            assert ("unagreed-selection-input",
+                    _line_of(lines, marker)) in got, marker
+        assert len(rep.findings) == 3
+        # the agreed-annotated read is enumerated, not flagged
+        assert [(a.what, a.how) for a in rep.agreed] == \
+            [("value", "launcher exports one env to every rank before spawn")]
+
+    def test_capture_impure_read(self):
+        rep, lines = _check_fixture("bad_impure.py")
+        got = {(f.check, f.line) for f in rep.findings}
+        assert ("capture-impure-read",
+                _line_of(lines, "VIOLATION: env read on step path")) in got
+        assert ("capture-impure-read",
+                _line_of(lines, "VIOLATION: host I/O on step path")) in got
+        # __init__ knob resolution and the off-path read are exempt
+        assert len(rep.findings) == 2
+
+    def test_suppression_hygiene(self):
+        rep, lines = _check_fixture("bad_suppression.py")
+        checks = {f.check: f.line for f in rep.findings}
+        assert checks["bad-suppression"] == \
+            _line_of(lines, "divcheck: ignore", 0)
+        assert checks["stale-suppression"] == \
+            _line_of(lines, "old excuse for code that changed")
+        assert checks["bad-annotation"] == \
+            _line_of(lines, "divcheck: agreed[]")
+        assert checks["stale-agreed"] == \
+            _line_of(lines, "nothing here is rank-local")
+        assert rep.suppressions == []
+        assert rep.agreed == []
+
+    def test_cross_file_call_graph(self):
+        rep, _ = _check_fixture("xfile")
+        f, = rep.findings
+        assert f.check == "rank-gated-collective"
+        assert f.file.endswith("gated.py")
+        lines = open(os.path.join(FIXTURES, "xfile",
+                                  "gated.py")).read().splitlines()
+        assert f.line == _line_of(lines, "VIOLATION: cross-file rank gate")
+        assert "sync_gradients" in f.message
+
+    def test_clean_fixture_zero_findings(self):
+        rep, _ = _check_fixture("clean.py")
+        assert rep.findings == []
+        assert rep.suppressions == []
+        assert len(rep.agreed) == 1  # the agreed condition is enumerated
+
+
+class TestConventions:
+    def test_guard_return_gates_rest_of_block(self):
+        rep = divcheck.check_source(
+            "import horovod_tpu as hvd\n"
+            "def f(g, rank):\n"
+            "    if rank != 0:\n"
+            "        return g\n"
+            "    return hvd.allreduce(g)\n")
+        assert [(f.check, f.line) for f in rep.findings] == \
+            [("rank-gated-collective", 5)]
+
+    def test_size_gate_is_not_rank_local(self):
+        rep = divcheck.check_source(
+            "import horovod_tpu as hvd\n"
+            "def f(eng, g):\n"
+            "    if eng.backend.size() == 1:\n"
+            "        return g\n"
+            "    return hvd.allreduce(g)\n")
+        assert rep.findings == []
+
+    def test_agreed_condition_standalone_above(self):
+        rep = divcheck.check_source(
+            "import horovod_tpu as hvd\n"
+            "def f(g, rank):\n"
+            "    # divcheck: agreed[rank 0 broadcast decided this upstream]\n"
+            "    if rank == 0:\n"
+            "        return hvd.allreduce(g)\n"
+            "    return g\n")
+        assert rep.findings == []
+        assert [(a.line, a.what) for a in rep.agreed] == [(3, "condition")]
+
+    def test_agreed_order_on_for_loop(self):
+        rep = divcheck.check_source(
+            "import horovod_tpu as hvd\n"
+            "def f(names):\n"
+            "    out = []\n"
+            "    for n in set(names):  # divcheck: agreed[one name only ever lands here]\n"
+            "        out.append(hvd.allreduce(n))\n"
+            "    return out\n")
+        assert rep.findings == []
+        assert [a.what for a in rep.agreed] == ["order"]
+
+    def test_init_phase_exemption(self):
+        rep = divcheck.check_source(
+            "import os\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self.t = os.environ.get('K')\n"
+            "    def allreduce(self, x):\n"
+            "        return x\n")
+        assert rep.findings == []
+
+    def test_env_helper_defs_are_exempt_callers_are_not(self):
+        rep = divcheck.check_source(
+            "import os\n"
+            "def _get_int(name, default):\n"
+            "    return int(os.environ.get(name, default))\n"
+            "def allreduce(x):\n"
+            "    return x * _get_int('K', 1)\n")
+        assert [(f.check, f.line, f.func) for f in rep.findings] == \
+            [("capture-impure-read", 5, "allreduce")]
+
+    def test_reasoned_suppression_is_counted(self):
+        rep = divcheck.check_source(
+            "import horovod_tpu as hvd\n"
+            "def f(g, rank):\n"
+            "    if rank == 0:\n"
+            "        return hvd.allreduce(g)  # divcheck: ignore[single-rank tool path, never runs inside a job]\n"
+            "    return g\n")
+        assert rep.findings == []
+        assert [(s.check, s.reason) for s in rep.suppressions] == \
+            [("rank-gated-collective",
+              "single-rank tool path, never runs inside a job")]
+
+    def test_trailing_suppression_does_not_bleed(self):
+        rep = divcheck.check_source(
+            "import horovod_tpu as hvd\n"
+            "def f(g, rank):\n"
+            "    if rank == 0:\n"
+            "        hvd.allreduce(g)  # divcheck: ignore[excused line]\n"
+            "        hvd.broadcast(g, 0)\n"
+            "    return g\n")
+        assert [(f.check, f.line) for f in rep.findings] == \
+            [("rank-gated-collective", 5)]
+        assert len(rep.suppressions) == 1
+
+    def test_common_names_do_not_propagate(self):
+        # a def named get() that allreduces must not make every dict.get
+        # call in the tree collective-issuing
+        rep = divcheck.check_sources({
+            "a.py": ("import horovod_tpu as hvd\n"
+                     "class C:\n"
+                     "    def get(self):\n"
+                     "        return hvd.allreduce(1)\n"),
+            "b.py": ("def f(d, rank):\n"
+                     "    if rank == 0:\n"
+                     "        return d.get('k')\n"
+                     "    return None\n")})
+        assert rep.findings == []
+
+    def test_self_call_resolution_beats_name_collision(self):
+        # Registry._get calls self._validate — its OWN _validate, not the
+        # estimator's collective-issuing one
+        rep = divcheck.check_sources({
+            "a.py": ("import horovod_tpu as hvd\n"
+                     "class Estimator:\n"
+                     "    def _probe(self):\n"
+                     "        return hvd.allreduce(1)\n"),
+            "b.py": ("class Registry:\n"
+                     "    def _probe(self):\n"
+                     "        return 1\n"
+                     "    def lookup(self, rank):\n"
+                     "        if rank == 0:\n"
+                     "            return self._probe()\n"
+                     "        return None\n")})
+        assert rep.findings == []
+
+    def test_world_version_subscript_compare(self):
+        rep = divcheck.check_source(
+            "import horovod_tpu as hvd\n"
+            "def f(hdr, cached, g):\n"
+            "    if hdr['world_version'] != cached:\n"
+            "        hvd.barrier()\n"
+            "    return g\n")
+        assert [(f.check, f.line) for f in rep.findings] == \
+            [("rank-gated-collective", 4)]
+
+    def test_unparseable_file_is_a_finding_not_a_crash(self):
+        rep = divcheck.check_source("def broken(:\n  '''unterminated\n")
+        assert [f.check for f in rep.findings] == ["parse-error"]
+
+
+class TestLiveTree:
+    def test_horovod_tpu_is_divergence_clean(self):
+        rep = divcheck.check_package(PKG_ROOT)
+        assert rep.findings == [], "\n".join(str(f) for f in rep.findings)
+
+    def test_every_live_suppression_carries_a_reason(self):
+        rep = divcheck.check_package(PKG_ROOT)
+        assert rep.suppressions, "the annotated tree should have suppressions"
+        for s in rep.suppressions:
+            assert s.reason and s.reason.strip(), str(s)
+
+    def test_every_live_agreed_site_documents_the_exchange(self):
+        rep = divcheck.check_package(PKG_ROOT)
+        assert rep.agreed, "the annotated tree should have agreed sites"
+        for a in rep.agreed:
+            assert a.how and a.how.strip(), f"{a.file}:{a.line}"
+
+    def test_scan_coverage_is_not_vacuous(self):
+        # a gutted call graph would zero these out long before any
+        # finding regressed — pin the floor
+        rep = divcheck.check_package(PKG_ROOT)
+        assert rep.files >= 60
+        assert rep.defs >= 700
+        assert rep.issuing_defs >= 80
+        assert rep.step_path_defs >= 100

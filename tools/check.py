@@ -1,13 +1,21 @@
 """Unified static-analysis driver: every lint, one command, one report.
 
-Runs the six analysis passes the repo has accumulated (PRs 3-5 grew one
-script per namespace; ISSUE 7 consolidated them and added the
-concurrency lints; ISSUE 9 added the checkpoint-manifest contract):
+Runs the seven analysis passes the repo has accumulated (PRs 3-5 grew
+one script per namespace; ISSUE 7 consolidated them and added the
+concurrency lints; ISSUE 9 added the checkpoint-manifest contract;
+ISSUE 11 added the SPMD divergence checker):
 
 - ``lockcheck``     — GUARDED_BY lock-discipline checker over
                       ``horovod_tpu/`` (horovod_tpu.analysis.lockcheck)
+- ``divcheck``      — SPMD divergence & dispatch-determinism checker:
+                      rank-gated collectives, nondeterministic
+                      submission order, unagreed selection inputs,
+                      capture-impure reads
+                      (horovod_tpu.analysis.divcheck)
 - ``knobs``         — configuration-knob registry lint: env reads vs
-                      KNOB_SPECS (horovod_tpu.analysis.knobcheck)
+                      KNOB_SPECS, declared choices/types vs defaults,
+                      raw reads of choice knobs
+                      (horovod_tpu.analysis.knobcheck)
 - ``metrics``       — METRIC_SPECS namespace lint
                       (tools/check_metric_names.py)
 - ``faults``        — FAULT_SPECS + failpoint call-site lint
@@ -26,21 +34,34 @@ Usage (from the repo root)::
 
     python tools/check.py                  # all lints, text report
     python tools/check.py --format=json    # machine-readable report
+    python tools/check.py --format=github  # GitHub Actions annotations
     python tools/check.py --only lockcheck,knobs
+    python tools/check.py --changed        # fast dev loop: pure-AST
+                                           # lints, findings filtered to
+                                           # files changed vs main
     python tools/check.py --list
 
 Exit code 0 iff every selected lint passed. The JSON report carries, per
-lint, ``ok`` / ``errors`` / ``stats`` — and for lockcheck the full
-suppression list with reasons, so "zero unexplained suppressions" is
-auditable from the report alone. Invoked from one tier-1 test
-(tests/test_check.py, ``pytest -m lint``); the per-lint scripts remain
+lint, ``ok`` / ``errors`` / ``stats`` — and for lockcheck/divcheck the
+full suppression (and agreed-site) lists with reasons, so "zero
+unexplained suppressions" is auditable from the report alone. Invoked
+from one tier-1 test (tests/test_check.py, ``pytest -m lint``) and the
+CI workflow (.github/workflows/lint.yml); the per-lint scripts remain
 as thin shims for single-lint runs.
+
+``--changed`` is the dev-loop fast mode: it runs only the pure-AST
+lints (lockcheck, divcheck, knobs — the ones that don't import jax or
+run live subsystems), scanning the WHOLE tree so cross-file passes stay
+sound, but filtering lockcheck/divcheck findings to files changed vs
+``main`` (git diff --name-only + working-tree changes). The full scan
+stays the tier-1/CI default.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
 import sys
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -54,14 +75,41 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 PKG_ROOT = os.path.join(REPO, "horovod_tpu")
 
 
-def run_lockcheck() -> Tuple[List[str], dict]:
+def run_lockcheck(changed: Optional[set] = None) -> Tuple[List[str], dict]:
     from horovod_tpu.analysis import lockcheck
     rep = lockcheck.check_package(PKG_ROOT)
-    errors = [str(f) for f in rep.findings]
+    findings = rep.findings
+    if changed is not None:
+        findings = [f for f in findings if f.file in changed]
+    errors = [str(f) for f in findings]
     stats = {"files": rep.files,
              "classes_annotated": rep.classes_annotated,
              "guarded_attrs": rep.guarded_attrs,
              "suppressions": [s.to_dict() for s in rep.suppressions]}
+    if changed is not None:
+        stats["changed_files"] = len(changed)
+    return errors, stats
+
+
+def run_divcheck(changed: Optional[set] = None) -> Tuple[List[str], dict]:
+    """SPMD divergence checker (ISSUE 11). The whole tree is always
+    scanned — the collective-issuing set and step-path footprint are
+    cross-file — but ``--changed`` filters the *findings* to the files
+    being worked on."""
+    from horovod_tpu.analysis import divcheck
+    rep = divcheck.check_package(PKG_ROOT)
+    findings = rep.findings
+    if changed is not None:
+        findings = [f for f in findings if f.file in changed]
+    errors = [str(f) for f in findings]
+    stats = {"files": rep.files,
+             "defs": rep.defs,
+             "issuing_defs": rep.issuing_defs,
+             "step_path_defs": rep.step_path_defs,
+             "suppressions": [s.to_dict() for s in rep.suppressions],
+             "agreed_sites": [a.to_dict() for a in rep.agreed]}
+    if changed is not None:
+        stats["changed_files"] = len(changed)
     return errors, stats
 
 
@@ -194,6 +242,7 @@ def run_ckpt_manifest() -> Tuple[List[str], dict]:
 
 CHECKS: Dict[str, Callable[[], Tuple[List[str], dict]]] = {
     "lockcheck": run_lockcheck,
+    "divcheck": run_divcheck,
     "knobs": run_knobs,
     "metrics": run_metrics,
     "faults": run_faults,
@@ -201,11 +250,46 @@ CHECKS: Dict[str, Callable[[], Tuple[List[str], dict]]] = {
     "ckpt_manifest": run_ckpt_manifest,
 }
 
+# lints whose findings carry file:line and can be filtered to a changed
+# subset; also the pure-AST set --changed runs (knobs is pure-AST too
+# but registry-global: dead-knob detection needs the whole tree either
+# way, and it is cheap)
+FILE_SCOPED = ("lockcheck", "divcheck")
+CHANGED_MODE_LINTS = ("lockcheck", "divcheck", "knobs")
 
-def run_checks(only: Optional[List[str]] = None) -> dict:
+
+def changed_files(base: str = "main") -> set:
+    """Repo-relative paths this branch is working on: commits since the
+    merge-base with ``base`` (``base...HEAD`` — NOT ``base``'s tip, so
+    files that only moved on main never leak into the filter), plus
+    staged/working-tree edits vs HEAD, plus untracked files. Paths are
+    as the lint reports spell them (relative to the repo root)."""
+    import subprocess
+    out: set = set()
+    for args in (["git", "diff", "--name-only", f"{base}...HEAD"],
+                 ["git", "diff", "--name-only", "HEAD"],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            res = subprocess.run(args, cwd=REPO, capture_output=True,
+                                 text=True, timeout=30)
+        except Exception:
+            continue
+        if res.returncode == 0:
+            out.update(l.strip() for l in res.stdout.splitlines()
+                       if l.strip())
+    return out
+
+
+def run_checks(only: Optional[List[str]] = None,
+               changed: Optional[set] = None) -> dict:
     """Run the selected lints; returns the machine-readable report dict
-    ``{"ok": bool, "checks": {name: {"ok", "errors", "stats"}}}``."""
-    names = list(CHECKS) if not only else only
+    ``{"ok": bool, "checks": {name: {"ok", "errors", "stats"}}}``.
+    ``changed`` (a repo-relative path set) switches the file-scoped
+    lints to filtered findings — the ``--changed`` dev loop."""
+    if changed is not None and not only:
+        names = list(CHANGED_MODE_LINTS)
+    else:
+        names = list(CHECKS) if not only else only
     unknown = [n for n in names if n not in CHECKS]
     if unknown:
         raise ValueError(f"unknown lint(s): {', '.join(unknown)} "
@@ -213,7 +297,10 @@ def run_checks(only: Optional[List[str]] = None) -> dict:
     report: dict = {"ok": True, "checks": {}}
     for name in names:
         try:
-            errors, stats = CHECKS[name]()
+            if changed is not None and name in FILE_SCOPED:
+                errors, stats = CHECKS[name](changed=changed)
+            else:
+                errors, stats = CHECKS[name]()
         except Exception as e:  # a crashed lint is a failed lint, loudly
             errors, stats = [f"lint crashed: {type(e).__name__}: {e}"], {}
         report["checks"][name] = {"ok": not errors, "errors": errors,
@@ -236,6 +323,35 @@ def _print_text(report: dict):
         for s in stats.get("suppressions", []):
             print(f"       suppressed [{s['check']}] {s['file']}:"
                   f"{s['line']} — {s['reason']}")
+        for a in stats.get("agreed_sites", []):
+            print(f"       agreed[{a['what']}] {a['file']}:{a['line']} "
+                  f"— {a['how']}")
+    n_fail = sum(1 for r in report["checks"].values() if not r["ok"])
+    total = len(report["checks"])
+    print(f"{total - n_fail}/{total} lints passed")
+
+
+_LOC_RE = re.compile(r"^([\w./-]+\.py):(\d+):\s*(.*)$", re.S)
+
+
+def _gh_escape(msg: str) -> str:
+    # workflow-command message encoding: % first, then the line breaks
+    return (msg.replace("%", "%25").replace("\r", "%0D")
+            .replace("\n", "%0A"))
+
+
+def _print_github(report: dict):
+    """GitHub Actions workflow-command emitter: one ``::error`` per
+    finding, annotated onto the file/line when the error string carries
+    a ``path:line:`` prefix."""
+    for name, res in report["checks"].items():
+        for e in res["errors"]:
+            m = _LOC_RE.match(e)
+            if m:
+                msg = _gh_escape(f"[{name}] {m.group(3)}")
+                print(f"::error file={m.group(1)},line={m.group(2)}::{msg}")
+            else:
+                print("::error::" + _gh_escape(f"[{name}] {e}"))
     n_fail = sum(1 for r in report["checks"].values() if not r["ok"])
     total = len(report["checks"])
     print(f"{total - n_fail}/{total} lints passed")
@@ -246,9 +362,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="Unified static-analysis driver "
                     "(docs/static_analysis.md)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "github"),
+                    default="text")
     ap.add_argument("--only", default=None,
                     help="comma-separated subset of lints to run")
+    ap.add_argument("--changed", action="store_true",
+                    help="fast dev loop: pure-AST lints only, findings "
+                         "filtered to files changed vs --base")
+    ap.add_argument("--base", default="main",
+                    help="git ref --changed diffs against (default: main)")
     ap.add_argument("--list", action="store_true",
                     help="list available lints and exit")
     args = ap.parse_args(argv)
@@ -257,14 +379,21 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(name)
         return 0
     only = [s.strip() for s in args.only.split(",")] if args.only else None
+    changed = changed_files(args.base) if args.changed else None
     try:
-        report = run_checks(only)
+        report = run_checks(only, changed=changed)
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 2
     if args.format == "json":
         print(json.dumps(report, indent=2))
+    elif args.format == "github":
+        _print_github(report)
     else:
+        if changed is not None:
+            touched = sorted(f for f in changed if f.endswith(".py"))
+            print(f"[--changed] {len(touched)} changed .py file(s) vs "
+                  f"{args.base}; full scan remains the tier-1/CI default")
         _print_text(report)
     return 0 if report["ok"] else 1
 

@@ -99,6 +99,7 @@ SECTIONS = [
     ("Autotuning", "horovod_tpu.autotune.parameter_manager", []),
     ("Static analysis", "horovod_tpu.analysis", []),
     ("", "horovod_tpu.analysis.lockcheck", []),
+    ("", "horovod_tpu.analysis.divcheck", []),
     ("", "horovod_tpu.analysis.knobcheck", []),
     ("", "horovod_tpu.common.knobs", []),
 ]
