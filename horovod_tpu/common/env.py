@@ -165,6 +165,25 @@ HOROVOD_TPU_TREE_THRESHOLD_BYTES = "HOROVOD_TPU_TREE_THRESHOLD_BYTES"
 # through the CheckpointManager and elastic recovery falls back to the
 # last durable generation when the in-memory commit is gone
 HOROVOD_TPU_CHECKPOINT_DIR = "HOROVOD_TPU_CHECKPOINT_DIR"
+# replicated control plane (ISSUE 12, runner/replication.py +
+# runner/http_client.py): ENDPOINTS is the client-side replica set spec
+# ("h1:p1,h2:p2") overriding the single rendezvous addr for every KV
+# consumer; BREAKER_* shape the per-endpoint circuit breaker
+# (consecutive-failure trip count, base reopen delay); LEASE_* drive the
+# primary heartbeat stream and the standby's staggered promotion timeout;
+# ACK_REPLICAS overrides the write-ack quorum (0 = majority of the
+# replica set); JOURNAL_MAX bounds the in-memory replication journal;
+# SCOPE_BUDGET_BYTES is the per-scope byte budget behind the server's
+# 429 backpressure path (0 = unlimited). All resolved once at init —
+# never re-read on a request or step path (docs/control_plane.md).
+HOROVOD_KV_ENDPOINTS = "HOROVOD_KV_ENDPOINTS"
+HOROVOD_KV_BREAKER_FAILURES = "HOROVOD_KV_BREAKER_FAILURES"
+HOROVOD_KV_BREAKER_RESET = "HOROVOD_KV_BREAKER_RESET"
+HOROVOD_KV_LEASE_TIMEOUT = "HOROVOD_KV_LEASE_TIMEOUT"
+HOROVOD_KV_LEASE_INTERVAL = "HOROVOD_KV_LEASE_INTERVAL"
+HOROVOD_KV_ACK_REPLICAS = "HOROVOD_KV_ACK_REPLICAS"
+HOROVOD_KV_JOURNAL_MAX = "HOROVOD_KV_JOURNAL_MAX"
+HOROVOD_KV_SCOPE_BUDGET_BYTES = "HOROVOD_KV_SCOPE_BUDGET_BYTES"
 HOROVOD_TPU_CHECKPOINT_INTERVAL_STEPS = "HOROVOD_TPU_CHECKPOINT_INTERVAL_STEPS"
 HOROVOD_TPU_CHECKPOINT_REDUNDANCY = "HOROVOD_TPU_CHECKPOINT_REDUNDANCY"
 HOROVOD_TPU_CHECKPOINT_KEEP = "HOROVOD_TPU_CHECKPOINT_KEEP"

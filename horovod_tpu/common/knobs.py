@@ -202,6 +202,48 @@ KNOB_SPECS: Dict[str, dict] = {
         "help": "Chunk size for large-value shard transfers through the "
                 "rendezvous KV (one multi-hundred-MB PUT would fight "
                 "the capped per-request socket timeout)."},
+    # -- replicated control plane (ISSUE 12) --------------------------------
+    "HOROVOD_KV_ENDPOINTS": {
+        "type": "str", "default": "",
+        "help": "Control-plane replica set (\"h1:p1,h2:p2\") every KV "
+                "client fails over across; overrides the single "
+                "rendezvous addr/port for publishers, checkpointing, and "
+                "fault arming. Resolved once at init."},
+    "HOROVOD_KV_BREAKER_FAILURES": {
+        "type": "int", "default": "3",
+        "help": "Consecutive transport failures before a KV endpoint's "
+                "circuit breaker trips open (half-open probe after a "
+                "jittered, per-trip-doubling reopen delay)."},
+    "HOROVOD_KV_BREAKER_RESET": {
+        "type": "float", "default": "0.5",
+        "help": "Base seconds a tripped KV endpoint breaker stays open "
+                "before its half-open probe (doubles per trip, "
+                "jittered)."},
+    "HOROVOD_KV_LEASE_TIMEOUT": {
+        "type": "float", "default": "2.0",
+        "help": "Seconds a standby tolerates lease silence from the "
+                "primary before promoting itself (staggered by its "
+                "replica-set index; the fenced-epoch handoff)."},
+    "HOROVOD_KV_LEASE_INTERVAL": {
+        "type": "float", "default": "0.5",
+        "help": "Seconds between the primary's lease/catch-up "
+                "replication ticks to each standby."},
+    "HOROVOD_KV_ACK_REPLICAS": {
+        "type": "int", "default": "0",
+        "help": "Replicas (including the primary) that must apply a "
+                "write before it is acked; 0 = majority of the "
+                "configured replica set."},
+    "HOROVOD_KV_JOURNAL_MAX": {
+        "type": "int", "default": "8192",
+        "help": "In-memory replication journal entries retained; peers "
+                "behind the retained window resync via a full snapshot "
+                "push."},
+    "HOROVOD_KV_SCOPE_BUDGET_BYTES": {
+        "type": "int", "default": "0",
+        "help": "Per-scope KV byte budget behind the 429 + Retry-After "
+                "backpressure path (telemetry publishers shed on it, "
+                "counted in hvd_tpu_kv_shed_bytes_total); 0 = "
+                "unlimited."},
     # -- metrics & telemetry ------------------------------------------------
     "HOROVOD_TPU_METRICS": {
         "type": "bool", "default": "1",

@@ -41,9 +41,20 @@ class GlobalState:
         import os
         cfg = self.config
         kv = None
+        # Replicated control plane (ISSUE 12): HOROVOD_KV_ENDPOINTS names
+        # the whole replica set ("h1:p1,h2:p2"); every consumer below
+        # (stall inspector, trace/metrics publishers, checkpoint manager)
+        # then fails over across it. Resolved ONCE here, at init, off the
+        # step path — the endpoint set is frozen for the engine's life.
+        # The single rendezvous addr/port stays the fallback (and may
+        # itself carry a comma-spec, which the client parses the same way).
+        kv_spec = os.environ.get(env_mod.HOROVOD_KV_ENDPOINTS)
         rdv_addr = os.environ.get(env_mod.HOROVOD_GLOO_RENDEZVOUS_ADDR)
         rdv_port = os.environ.get(env_mod.HOROVOD_GLOO_RENDEZVOUS_PORT)
-        if rdv_addr and rdv_port:
+        if kv_spec:
+            from ..runner.http_client import resolve_endpoints
+            kv = (resolve_endpoints(kv_spec), None)
+        elif rdv_addr and rdv_port:
             kv = (rdv_addr, int(rdv_port))
         if cfg.timeline_path:
             from ..timeline import Timeline

@@ -44,15 +44,18 @@ class ElasticRendezvousServer(RendezvousServer):
             # New world ⇒ new JAX coordinator; drop the stale address so
             # non-zero ranks block until the new rank 0 republishes it
             # (ordering guaranteed by this lock: any GET that sees the new
-            # plan also sees the cleared coordinator scope).
-            self._store.pop(self.SCOPE_COORD, None)
+            # plan also sees the cleared coordinator scope). Mutations go
+            # through the locked core so scope byte totals track the
+            # store (ISSUE 12 backpressure accounting).
+            self._store_apply_locked("clear", self.SCOPE_COORD, "", None)
             # stale notification endpoints would each cost a 5s connect
             # timeout on every membership push; workers reregister after
             # reset anyway
-            self._store.pop(self.SCOPE_WORKER_ADDRS, None)
+            self._store_apply_locked("clear", self.SCOPE_WORKER_ADDRS, "",
+                                     None)
             if coordinator_addr is not None:
-                self._store[self.SCOPE_COORD]["addr"] = \
-                    coordinator_addr.encode()
+                self._store_apply_locked("put", self.SCOPE_COORD, "addr",
+                                         coordinator_addr.encode())
         return self.port
 
     def handle_get(self, scope: str, key: str, handler):

@@ -105,6 +105,19 @@ FAULT_SPECS: Dict[str, str] = {
                      "server connection, drop() serves a 404",
     "kv.server.put": "In the KV server's PUT handler; drop() silently "
                      "discards the write (acks 200 without storing)",
+    # runner/replication.py (ISSUE 12 replicated control plane)
+    "kv.replicate": "Before each per-peer journal-stream send on the "
+                    "primary's replication path; raise() models a peer "
+                    "send failure (write may miss its ack quorum), "
+                    "delay() a slow standby, hang() a wedged stream",
+    "kv.promote": "At the top of a standby's promotion (lease-expiry or "
+                  "manual); delay() widens the failover window, raise() "
+                  "models a promotion that must surface loudly",
+    "kv.journal_gap": "Inside the promotion-time journal replay/audit; "
+                      "drop() injects a synthetic sequence gap so the "
+                      "gap-detection path (ERROR + "
+                      "hvd_tpu_kv_journal_gaps_total) is exercisable "
+                      "deterministically",
     # elastic/
     "elastic.rendezvous.get": "In the elastic rendezvous rank_and_size "
                               "lookup; drop() long-polls the worker",
@@ -412,27 +425,32 @@ def hits(name: str) -> int:
     return reg.hits(name) if reg is not None else 0
 
 
-def arm_from_kv(addr: str, port: int, scope: str = "faults",
+def arm_from_kv(addr, port: Optional[int] = None, scope: str = "faults",
                 key: str = "spec", timeout: float = 5.0) -> bool:
     """Fetch a fault spec from the rendezvous KV and arm it — the
     one-place-arms-every-worker path for real np>1 chaos runs (the launcher
-    PUTs ``faults/spec``; each worker calls this after init). Returns False
+    PUTs ``faults/spec``; each worker calls this after init). ``addr``
+    accepts the full endpoint-set forms of the KV client — an
+    :class:`..runner.http_client.Endpoints`, a ``"h1:p1,h2:p2"`` spec, or
+    the legacy ``(addr, port)`` — so chaos scripts can arm faults through
+    a surviving replica after a root kill (ISSUE 12). Returns False
     (with a WARNING, staying disarmed) only when the key never appeared
     within ``timeout``; any other failure — bad spec, undeclared name,
     non-404 HTTP error — raises, so a chaos run can never silently proceed
     with one worker unarmed."""
-    from .runner.http_client import read_data_from_kvstore
+    from .runner.http_client import read_data_from_kvstore, resolve_endpoints
+    eps = resolve_endpoints(addr, port)
     try:
-        raw = read_data_from_kvstore(addr, port, scope, key, timeout=timeout)
+        raw = read_data_from_kvstore(eps, None, scope, key, timeout=timeout)
     except TimeoutError as e:
-        logger.warning("no fault spec at %s:%s/%s/%s within %.0fs; "
-                       "running fault-free (%s)", addr, port, scope, key,
+        logger.warning("no fault spec at %s/%s/%s within %.0fs; "
+                       "running fault-free (%s)", eps.spec, scope, key,
                        timeout, e)
         return False
     spec = raw.decode().strip()
     if not spec:
-        logger.warning("fault spec at %s:%s/%s/%s is empty; running "
-                       "fault-free", addr, port, scope, key)
+        logger.warning("fault spec at %s/%s/%s is empty; running "
+                       "fault-free", eps.spec, scope, key)
         return False
     arm(spec)
     return True

@@ -159,6 +159,36 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
     "hvd_tpu_kv_gave_up_total": (
         "counter", "Control-plane KV operations that exhausted their "
                    "retry budget, by op"),
+    # runner/http_client.py + runner/http_server.py + runner/replication.py
+    # (ISSUE 12 replicated control plane)
+    "hvd_tpu_kv_failover_total": (
+        "counter", "KV requests that succeeded only after failing over "
+                   "past a dead/not-primary endpoint of the replica set, "
+                   "by op"),
+    "hvd_tpu_kv_breaker_open_total": (
+        "counter", "KV endpoint circuit-breaker trips (consecutive "
+                   "transport failures -> open, jittered half-open "
+                   "probe), by endpoint"),
+    "hvd_tpu_kv_shed_bytes_total": (
+        "counter", "Telemetry publish bytes shed on server backpressure "
+                   "(429 per-scope byte budget) instead of blocking the "
+                   "step path, by scope — degradation made visible, "
+                   "never silent"),
+    "hvd_tpu_kv_backpressure_total": (
+        "counter", "KV writes refused with 429 + Retry-After (per-scope "
+                   "byte budget), by scope — counted on the server"),
+    "hvd_tpu_kv_repl_entries_total": (
+        "counter", "Journal entries streamed from the KV primary to its "
+                   "standbys"),
+    "hvd_tpu_kv_promotions_total": (
+        "counter", "KV standby promotions (lease-expiry or manual "
+                   "epoch handoffs)"),
+    "hvd_tpu_kv_journal_gaps_total": (
+        "counter", "Sequence gaps detected by the replication journal "
+                   "audit (promotion replay) — never silently skipped"),
+    "hvd_tpu_kv_fenced_writes_total": (
+        "counter", "Stale-epoch replication messages rejected by the "
+                   "fence (zombie ex-primary streams)"),
     # faults.py
     "hvd_tpu_fault_injections_total": (
         "counter", "Fired fault-injection actions, by failpoint name and "
@@ -586,11 +616,19 @@ def publish_snapshot(kv: Tuple[str, int], rank: int, snap: dict,
     them. Shared by the MetricsEmitter and by tests that need a
     deterministic publish."""
     from .faults import DROP, failpoint
-    from .runner.http_client import put_data_into_kvstore
+    from .runner.http_client import (KVBackpressure, count_shed_bytes,
+                                     put_data_into_kvstore)
     if failpoint("metrics.publish") is DROP:
         return
-    put_data_into_kvstore(kv[0], kv[1], METRICS_KV_SCOPE, str(rank),
-                          json.dumps(snap).encode(), timeout=timeout)
+    payload = json.dumps(snap).encode()
+    try:
+        put_data_into_kvstore(kv[0], kv[1], METRICS_KV_SCOPE, str(rank),
+                              payload, timeout=timeout)
+    except KVBackpressure:
+        # server asked for shedding (scope byte budget): drop this
+        # snapshot — the next tick's supersedes it anyway (last-writer-
+        # wins key) — and make the degradation visible, never silent
+        count_shed_bytes(METRICS_KV_SCOPE, len(payload))
 
 
 def counter_total(snap: dict, name: str) -> float:

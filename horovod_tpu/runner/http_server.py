@@ -9,6 +9,15 @@ Role in the TPU build: the launcher starts one of these on the driver; workers
 fetch their ``SlotInfo`` (rank/local/cross) and the JAX coordinator address
 from it, and the elastic driver uses the PUT channel for worker address
 registration (reference elastic/rendezvous.py:37-55).
+
+Replicated control plane (ISSUE 12): :meth:`KVStoreServer.enable_replication`
+attaches a :class:`..runner.replication.ReplicaCoordinator` — client
+mutations on the primary are journaled and streamed to hot standbys (acked
+means applied on an ack quorum), standbys serve reads and answer writes with
+``409 not-primary`` + the primary hint, and a standby whose lease expires
+promotes itself under a fenced epoch (docs/control_plane.md). Per-scope byte
+budgets answer over-budget writes with ``429 + Retry-After`` so telemetry
+publishers shed instead of piling onto a struggling control plane.
 """
 
 from __future__ import annotations
@@ -19,7 +28,7 @@ import logging
 import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from ..faults import DROP, failpoint
 
@@ -28,6 +37,7 @@ _LOG = logging.getLogger("horovod_tpu.runner")
 OK = 200
 NOT_FOUND = 404
 BAD_REQUEST = 400
+TOO_MANY_REQUESTS = 429
 
 # Prometheus exposition content type (text format 0.0.4)
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -40,6 +50,19 @@ TRACE_SCOPE = "trace"
 # GET /clock serves the server's wall clock — the clock-alignment beacon
 # every rank pairs with its local monotonic clock (trace.py)
 CLOCK_SCOPE = "clock"
+# reserved replication-control scope (runner/replication.py): PUT apply/
+# snapshot between replicas, GET status/journal for operators and tests
+REPL_SCOPE = "_repl"
+
+
+def _normalize(result) -> Tuple[int, dict, bytes]:
+    """Handler callbacks may return a bare status code or a
+    ``(code, headers, body)`` tuple (backpressure and replication answers
+    carry headers/bodies); normalize for the HTTP layer."""
+    if isinstance(result, tuple):
+        code, headers, body = result
+        return int(code), dict(headers or {}), bytes(body or b"")
+    return int(result), {}, b""
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -55,6 +78,16 @@ class _KVHandler(BaseHTTPRequestHandler):
         key = parts[1] if len(parts) > 1 else ""
         return scope, key
 
+    def _reply(self, result):
+        code, headers, body = _normalize(result)
+        self.send_response(code)
+        for h, v in headers.items():
+            self.send_header(h, str(v))
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
     def do_GET(self):  # noqa: N802
         scope, key = self._split()
         value = self.server.handle_get(scope, key, self)
@@ -66,7 +99,8 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.send_response(OK)
         if scope == METRICS_SCOPE and not key:
             self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
-        elif (scope in (TRACE_SCOPE, CLOCK_SCOPE)) and not key:
+        elif (scope in (TRACE_SCOPE, CLOCK_SCOPE, REPL_SCOPE)) and \
+                (not key or scope == REPL_SCOPE):
             self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(value)))
         self.end_headers()
@@ -76,19 +110,13 @@ class _KVHandler(BaseHTTPRequestHandler):
         scope, key = self._split()
         length = int(self.headers.get("Content-Length", "0"))
         value = self.rfile.read(length)
-        code = self.server.handle_put(scope, key, value, self)
-        self.send_response(code)
-        self.send_header("Content-Length", "0")
-        self.end_headers()
+        self._reply(self.server.handle_put(scope, key, value, self))
 
     def do_DELETE(self):  # noqa: N802
         # idempotent key removal (checkpoint GC drops stale chunked shard
         # values; see http_client.delete_data_from_kvstore)
         scope, key = self._split()
-        code = self.server.handle_delete(scope, key, self)
-        self.send_response(code)
-        self.send_header("Content-Length", "0")
-        self.end_headers()
+        self._reply(self.server.handle_delete(scope, key, self))
 
 
 class KVStoreServer(ThreadingHTTPServer):
@@ -100,6 +128,17 @@ class KVStoreServer(ThreadingHTTPServer):
     ``horovod_tpu.metrics`` (each series carries a ``rank`` label)."""
 
     daemon_threads = True
+
+    # lock discipline (tools/check.py lockcheck): the store, its per-scope
+    # byte totals, and the per-record (seq, epoch) replication metadata
+    # move together under the one store lock.
+    _GUARDED_BY = {
+        "_store": "_lock",
+        "_scope_bytes": "_lock",
+        "_record_meta": "_lock",
+        "_slots_by_key": "_lock",
+        "_skew_watermark": "_trace_render_lock",
+    }
 
     def handle_error(self, request, client_address):
         # A client that timed out and reconnected (capped per-request
@@ -115,9 +154,26 @@ class KVStoreServer(ThreadingHTTPServer):
 
     def __init__(self, addr=("0.0.0.0", 0)):
         super().__init__(addr, _KVHandler)
+        from ..common.env import HOROVOD_KV_SCOPE_BUDGET_BYTES, _get_int
         self._lock = threading.Lock()
         self._store: Dict[str, Dict[str, bytes]] = collections.defaultdict(dict)
         self._thread: Optional[threading.Thread] = None
+        # per-scope running byte totals + the default/override budgets
+        # (ISSUE 12 backpressure): a PUT that would grow a scope past its
+        # budget is answered 429 + Retry-After instead of stored. 0 = no
+        # budget. Budgets resolve once here (knob) or via
+        # set_scope_budget — never re-read per request.
+        self._scope_bytes: Dict[str, int] = {}
+        self._scope_budget_default = _get_int(
+            HOROVOD_KV_SCOPE_BUDGET_BYTES, 0)
+        self._scope_budgets: Dict[str, int] = {}
+        # per-record (seq, epoch) stamped by replicated mutations — the
+        # fenced-epoch trail of every replicated record
+        self._record_meta: Dict[str, Dict[str, tuple]] = \
+            collections.defaultdict(dict)
+        # replication coordinator (runner/replication.py); None = the
+        # classic standalone server, zero new work on any path
+        self._repl = None
         # per-name highest observed (world_version, seq) by the /trace
         # skew observation: repeat scrapes over the same ring snapshot
         # must not re-observe the same collectives into the histogram.
@@ -126,6 +182,140 @@ class KVStoreServer(ThreadingHTTPServer):
         # the same collectives)
         self._skew_watermark: Dict[str, tuple] = {}
         self._trace_render_lock = threading.Lock()
+
+    # -- public state accessors ---------------------------------------------
+
+    def snapshot(self, scope: Optional[str] = None
+                 ) -> Dict[str, Dict[str, bytes]]:
+        """Consistent copy of the store under the lock — the public
+        surface tests and the replication snapshot push use instead of
+        reaching into ``_lock``/``_store`` privates (ISSUE 12). With
+        ``scope``, only that scope is copied (the scrape/trace renders —
+        copying every checkpoint chunk key per scrape would stretch the
+        lock hold for no reason)."""
+        with self._lock:
+            if scope is not None:
+                kv = self._store.get(scope)
+                return {scope: dict(kv)} if kv else {}
+            return {scope: dict(kv) for scope, kv in self._store.items()}
+
+    def clear_all(self):
+        """Drop every scope (test isolation helper)."""
+        with self._lock:
+            self._store.clear()
+            self._scope_bytes.clear()
+            self._record_meta.clear()
+
+    def scope_bytes(self, scope: str) -> int:
+        with self._lock:
+            return self._scope_bytes.get(scope, 0)
+
+    def set_scope_budget(self, scope: str, budget_bytes: int):
+        """Per-scope byte-budget override (0 disables); the knob
+        ``HOROVOD_KV_SCOPE_BUDGET_BYTES`` sets the default for every
+        scope."""
+        with self._lock:
+            self._scope_budgets[scope] = int(budget_bytes)
+
+    def enable_replication(self, self_addr: str, replicas, role="standby",
+                           config=None):
+        """Attach a replication coordinator: this server becomes one
+        replica of the ordered ``replicas`` endpoint set (``host:port``
+        strings; ``self_addr`` must be one of them). Returns the
+        coordinator (``.promote()``, ``.status()``, ``.audit_journal()``)."""
+        from .replication import ReplicaCoordinator
+        self._repl = ReplicaCoordinator(self, self_addr, list(replicas),
+                                        role=role, config=config)
+        return self._repl
+
+    @property
+    def replication(self):
+        return self._repl
+
+    # -- store mutation core (shared by direct and replicated paths) --------
+
+    def _store_apply(self, op: str, scope: str, key: str,
+                     value: Optional[bytes], seq: int = 0,
+                     epoch: int = 0) -> bool:
+        """Apply one mutation under the lock, maintaining byte totals and
+        the per-record (seq, epoch) metadata. Returns False only for a
+        delete of an absent key."""
+        with self._lock:
+            return self._store_apply_locked(op, scope, key, value,
+                                            seq=seq, epoch=epoch)
+
+    # requires: _lock
+    def _store_apply_locked(self, op: str, scope: str, key: str,
+                            value: Optional[bytes], seq: int = 0,
+                            epoch: int = 0) -> bool:
+        """The mutation core for callers already holding the lock
+        (RendezvousServer.init swaps the slot plan and the coordinator
+        key under ONE lock hold — byte totals must move with the store
+        either way)."""
+        if op == "put":
+            old = self._store[scope].get(key)
+            self._scope_bytes[scope] = (
+                self._scope_bytes.get(scope, 0)
+                - (len(old) if old is not None else 0)
+                + len(value or b""))
+            self._store[scope][key] = value or b""
+            if seq:
+                self._record_meta[scope][key] = (seq, epoch)
+            return True
+        if op == "delete":
+            old = self._store.get(scope, {}).pop(key, None)
+            if old is not None:
+                self._scope_bytes[scope] = \
+                    self._scope_bytes.get(scope, 0) - len(old)
+            self._record_meta.get(scope, {}).pop(key, None)
+            return old is not None
+        if op == "clear":
+            self._store.pop(scope, None)
+            self._scope_bytes.pop(scope, None)
+            self._record_meta.pop(scope, None)
+            return True
+        raise ValueError(f"unknown store op {op!r}")
+
+    def _store_replace(self, store: Dict[str, Dict[str, bytes]],
+                       seq: int = 0, epoch: int = 0):
+        """Wholesale store replacement (replication snapshot install)."""
+        with self._lock:
+            self._store.clear()
+            self._scope_bytes.clear()
+            self._record_meta.clear()
+            for scope, kv in store.items():
+                self._store[scope] = dict(kv)
+                self._scope_bytes[scope] = sum(
+                    len(v) for v in kv.values())
+                if seq:
+                    for k in kv:
+                        self._record_meta[scope][k] = (seq, epoch)
+
+    def _check_budget(self, scope: str, key: str, value: bytes):
+        """429 + Retry-After when this PUT would grow ``scope`` past its
+        byte budget. Overwrites that shrink (or keep) the scope always
+        pass — a last-writer-wins publisher can never livelock itself
+        out of its own key."""
+        with self._lock:
+            budget = self._scope_budgets.get(scope,
+                                             self._scope_budget_default)
+            if budget <= 0:
+                return None
+            old = self._store.get(scope, {}).get(key)
+            delta = len(value) - (len(old) if old is not None else 0)
+            if delta <= 0 or \
+                    self._scope_bytes.get(scope, 0) + delta <= budget:
+                return None
+            total = self._scope_bytes.get(scope, 0)
+        from ..metrics import registry as metrics_registry
+        metrics_registry().counter("hvd_tpu_kv_backpressure_total").inc(
+            scope=scope)
+        body = json.dumps({"error": "scope_over_budget", "scope": scope,
+                           "budget": budget, "bytes": total,
+                           "put": len(value)}).encode()
+        return (TOO_MANY_REQUESTS,
+                {"Retry-After": "1", "Content-Type": "application/json"},
+                body)
 
     # -- handler callbacks --------------------------------------------------
 
@@ -145,13 +335,20 @@ class KVStoreServer(ThreadingHTTPServer):
             # rtt/2 midpoint estimate stays tight.
             import time
             return json.dumps({"ts": time.time()}).encode()
+        if scope == REPL_SCOPE:
+            if self._repl is None:
+                return None
+            if key == "status":
+                return json.dumps(self._repl.status()).encode()
+            if key == "journal":
+                return json.dumps(self._repl.audit_journal()).encode()
+            return None
         with self._lock:
             return self._store.get(scope, {}).get(key)
 
     def _render_metrics(self) -> bytes:
         from ..metrics import registry, render_prometheus_cluster
-        with self._lock:
-            payloads = dict(self._store.get(METRICS_SCOPE, {}))
+        payloads = self.snapshot(METRICS_SCOPE).get(METRICS_SCOPE, {})
         snaps = {}
         for rank, raw in payloads.items():
             try:
@@ -178,8 +375,7 @@ class KVStoreServer(ThreadingHTTPServer):
         rides the ``GET /metrics`` scrape (rank="driver")."""
         from ..metrics import registry
         from ..trace import render_cluster_trace
-        with self._lock:
-            payloads = dict(self._store.get(TRACE_SCOPE, {}))
+        payloads = self.snapshot(TRACE_SCOPE).get(TRACE_SCOPE, {})
         with self._trace_render_lock:
             return render_cluster_trace(payloads, reg=registry(),
                                         watermark=self._skew_watermark)
@@ -187,22 +383,53 @@ class KVStoreServer(ThreadingHTTPServer):
     def clear_scope(self, scope: str):
         """Drop every key under one scope (the elastic driver clears stale
         ``trace/<rank>`` segments when a new world activates, so a merged
-        trace never mixes ranks from two worlds)."""
-        with self._lock:
-            self._store.pop(scope, None)
+        trace never mixes ranks from two worlds). On a replicated primary
+        the clear is journaled like any client mutation so standbys
+        converge."""
+        if self._repl is not None:
+            code = _normalize(self._repl.client_write("clear", scope, "",
+                                                      None))[0]
+            if code != OK:
+                # a demoted/quorum-less replica cannot clear: surface it —
+                # stale segments would silently mix two worlds' ranks in
+                # the merged trace otherwise
+                _LOG.warning(
+                    "clear_scope(%r) refused by the replication tier "
+                    "(HTTP %d, role %s): stale keys may persist until the "
+                    "current primary clears the scope", scope, code,
+                    self._repl.status().get("role"))
+            return
+        self._store_apply("clear", scope, "", None)
 
-    def handle_put(self, scope: str, key: str, value: bytes, handler) -> int:
+    def handle_put(self, scope: str, key: str, value: bytes, handler):
         # drop() acks 200 without storing — the silent-loss fault the
         # retry/verify paths must survive
         if failpoint("kv.server.put") is DROP:
             return OK
-        with self._lock:
-            self._store[scope][key] = value
+        if scope == REPL_SCOPE:
+            if self._repl is None:
+                return NOT_FOUND
+            return self._repl.handle_control(key, value)
+        if self._repl is not None:
+            # the budget is enforced by the PRIMARY only: a standby's
+            # local/stale budget view answering 429 would be terminal for
+            # the client (KVBackpressure is deliberately not retried) —
+            # redirect first, let the authority decide
+            if self._repl.is_primary():
+                bp = self._check_budget(scope, key, value)
+                if bp is not None:
+                    return bp
+            return self._repl.client_write("put", scope, key, value)
+        bp = self._check_budget(scope, key, value)
+        if bp is not None:
+            return bp
+        self._store_apply("put", scope, key, value)
         return OK
 
-    def handle_delete(self, scope: str, key: str, handler) -> int:
-        with self._lock:
-            existed = self._store.get(scope, {}).pop(key, None) is not None
+    def handle_delete(self, scope: str, key: str, handler):
+        if self._repl is not None:
+            return self._repl.client_write("delete", scope, key, None)
+        existed = self._store_apply("delete", scope, key, None)
         return OK if existed else NOT_FOUND
 
     # -- lifecycle ----------------------------------------------------------
@@ -218,6 +445,8 @@ class KVStoreServer(ThreadingHTTPServer):
         return self.port
 
     def stop(self):
+        if self._repl is not None:
+            self._repl.stop()
         self.shutdown()
         self.server_close()
         if self._thread is not None:
@@ -243,14 +472,21 @@ class RendezvousServer(KVStoreServer):
         self._slots_by_key: Dict[str, "SlotInfo"] = {}
 
     def init(self, host_assignments, coordinator_addr: Optional[str] = None):
-        """(Re)load the host allocation plan; returns the server port."""
+        """(Re)load the host allocation plan; returns the server port.
+
+        The slot plan and coordinator key swap under ONE lock hold (a GET
+        must never see a half-updated pair); the coordinator write goes
+        through the locked mutation core so scope byte totals stay
+        consistent with the store. Note the plan itself is per-server
+        launcher config, not replicated state — see the fault-domain
+        table in docs/control_plane.md."""
         from .hosts import SlotInfo  # noqa: F401  (type only)
         with self._lock:
             self._slots_by_key = {
                 f"{s.hostname}:{s.local_rank}": s for s in host_assignments}
             if coordinator_addr is not None:
-                self._store[self.SCOPE_COORD]["addr"] = \
-                    coordinator_addr.encode()
+                self._store_apply_locked("put", self.SCOPE_COORD, "addr",
+                                         coordinator_addr.encode())
         return self.port
 
     def handle_get(self, scope: str, key: str, handler):

@@ -1,0 +1,757 @@
+"""Replicated KV control plane: journaled writes, hot standbys, lease/epoch
+promotion, and fencing (ISSUE 12 tentpole).
+
+Every subsystem built since PR 3 — elastic rendezvous, stall/metrics/trace
+publishing, clock beacons, checkpoint shard transfer — rides one
+``KVStoreServer``. This module makes that server replicable: a **primary**
+journals every client mutation (monotonic global ``seq`` plus a per-scope
+``sseq``) and streams the journal to one or more **standbys** over the same
+HTTP fabric (``PUT /_repl/apply``); an acked PUT/DELETE means the mutation
+is applied on an **ack quorum** of replicas (majority of the configured set
+by default), so an acked rendezvous registration, checkpoint-shard
+manifest, or blacklist entry is never lost to a single process death.
+Standbys serve reads (long-poll GETs included) from their replicated store
+and answer writes with ``409 not-primary`` + a primary hint the client tier
+follows.
+
+Promotion and fencing
+---------------------
+
+The primary's replication stream doubles as its **lease**: every tick (and
+every write) refreshes the standbys' ``last_lease``. A standby whose lease
+has been silent past ``HOROVOD_KV_LEASE_TIMEOUT * (1 + index)`` (index =
+its position in the replica set — deterministic stagger, no election
+protocol) promotes itself: it **replays/audits the journal** (per-scope
+``sseq`` and global ``seq`` contiguity — gaps are *detected and counted*,
+never silently skipped), bumps the **epoch**, and starts streaming to the
+remaining replicas. Every replication message carries the sender's epoch;
+a receiver fences anything stale (``412``), so a zombie ex-primary's late
+stream is rejected — and on seeing the fence (or any message with a newer
+epoch) the zombie **demotes itself to standby** and resyncs from the new
+primary via a full snapshot push. A client write accepted by a zombie can
+therefore never reach its ack quorum (the live replicas fence it), and the
+client's sweep fails over to the promoted standby.
+
+Consistency note: quorum acking is write-side only — a non-quorum write may
+be transiently visible on the replica that applied it before failing its
+ack; the client's idempotent retry converges it. That is exactly the
+last-writer-wins contract the KV always had (docs/control_plane.md).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from ..faults import DROP, failpoint
+
+logger = logging.getLogger("horovod_tpu.runner")
+
+REPL_SCOPE = "_repl"          # reserved control scope on every replica
+OK = 200
+CONFLICT = 409                # apply gap (body carries the applied seq)
+PRECONDITION_FAILED = 412     # stale epoch — the fence
+UNAVAILABLE = 503             # primary without quorum / standby mid-promote
+
+PRIMARY = "primary"
+STANDBY = "standby"
+
+# consecutive send failures before a peer is SUSPECT — excused from the
+# default (majority) ack-quorum denominator so a dead replica degrades
+# durability loudly instead of blocking every write forever (a 1+1 pair
+# must stay writable after either process dies; an explicitly configured
+# HOROVOD_KV_ACK_REPLICAS stays a hard requirement)
+SUSPECT_AFTER = 3
+
+
+def _b64e(value: Optional[bytes]) -> Optional[str]:
+    return None if value is None else base64.b64encode(value).decode()
+
+
+def _b64d(value: Optional[str]) -> Optional[bytes]:
+    return None if value is None else base64.b64decode(value)
+
+
+class ReplicationConfig:
+    """Frozen replication settings, resolved once at ``from_env`` (the
+    knob-read-at-init discipline — nothing here is re-read on any
+    request path)."""
+
+    # journal byte ceiling (in addition to the entry-count knob): the
+    # journal retains VALUE bytes, and a checkpoint-shard burst of 4 MiB
+    # chunks through the entry-count bound alone would pin tens of GB of
+    # history on every replica; past the ceiling the oldest entries are
+    # trimmed and lagging peers resync via snapshot push instead
+    DEFAULT_JOURNAL_MAX_BYTES = 64 * 1024 * 1024
+
+    def __init__(self, lease_timeout: float = 2.0,
+                 lease_interval: float = 0.5,
+                 ack_replicas: int = 0,
+                 journal_max: int = 8192,
+                 journal_max_bytes: Optional[int] = None):
+        self.lease_timeout = float(lease_timeout)
+        self.lease_interval = float(lease_interval)
+        self.ack_replicas = int(ack_replicas)
+        self.journal_max = int(journal_max)
+        self.journal_max_bytes = int(
+            journal_max_bytes if journal_max_bytes is not None
+            else self.DEFAULT_JOURNAL_MAX_BYTES)
+
+    @classmethod
+    def from_env(cls) -> "ReplicationConfig":
+        from ..common.env import (HOROVOD_KV_ACK_REPLICAS,
+                                  HOROVOD_KV_JOURNAL_MAX,
+                                  HOROVOD_KV_LEASE_INTERVAL,
+                                  HOROVOD_KV_LEASE_TIMEOUT, _get_float,
+                                  _get_int)
+        return cls(
+            lease_timeout=_get_float(HOROVOD_KV_LEASE_TIMEOUT, 2.0),
+            lease_interval=_get_float(HOROVOD_KV_LEASE_INTERVAL, 0.5),
+            ack_replicas=_get_int(HOROVOD_KV_ACK_REPLICAS, 0),
+            journal_max=_get_int(HOROVOD_KV_JOURNAL_MAX, 8192))
+
+
+class _Peer:
+    """One replica this node streams to. ``acked`` (highest seq the peer
+    confirmed applied; None = unknown, probe first) is guarded by the
+    coordinator lock; ``send_lock`` strictly serializes network sends to
+    the peer so the stream order is derived from the journal, never from
+    handler-thread arrival order."""
+
+    __slots__ = ("addr", "host", "port", "send_lock", "acked",
+                 "fail_streak", "suspect")
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        host, _, port_s = addr.rpartition(":")
+        self.host = host
+        self.port = int(port_s)
+        self.send_lock = threading.Lock()
+        self.acked: Optional[int] = None
+        self.fail_streak = 0
+        self.suspect = False
+
+
+class ReplicaCoordinator:
+    """Replication state machine attached to one ``KVStoreServer``.
+
+    The server delegates: client mutations on a primary flow through
+    :meth:`client_write`; ``/_repl/*`` control messages through
+    :meth:`handle_control` / :meth:`handle_status`. A background thread
+    (``kv-repl``) drives the primary's lease/catch-up stream and the
+    standby's lease-expiry promotion check.
+    """
+
+    # lock discipline (tools/check.py lockcheck): role/epoch/seq/journal
+    # and the lease bookkeeping are shared between HTTP handler threads,
+    # the kv-repl thread, and promote() callers. Peer.acked is coordinator
+    # state too (the _Peer slots carry no lock of their own for it);
+    # network sends happen OFF _lock, serialized per peer by
+    # _Peer.send_lock.
+    _GUARDED_BY = {
+        "role": "_lock",
+        "epoch": "_lock",
+        "seq": "_lock",
+        "scope_seq": "_lock",
+        "journal": "_lock",
+        "journal_bytes": "_lock",
+        "journal_base": "_lock",
+        "applied_seq": "_lock",
+        "last_lease": "_lock",
+        "primary_hint": "_lock",
+        "gap_log": "_lock",
+    }
+
+    def __init__(self, server, self_addr: str, replicas: List[str],
+                 role: str = STANDBY,
+                 config: Optional[ReplicationConfig] = None):
+        if role not in (PRIMARY, STANDBY):
+            raise ValueError(f"bad role {role!r}")
+        self.server = server
+        self.self_addr = str(self_addr)
+        self.replicas = [str(r) for r in replicas]
+        if self.self_addr not in self.replicas:
+            raise ValueError(
+                f"self_addr {self.self_addr!r} not in replica set "
+                f"{self.replicas}")
+        self.config = config or ReplicationConfig.from_env()
+        self._lock = threading.Lock()
+        self.role = role
+        self.epoch = 1
+        self.seq = 0                       # highest journaled seq (primary)
+        self.applied_seq = 0               # highest applied seq (standby);
+        #                                    -1 = diverged, needs snapshot
+        self.scope_seq: Dict[str, int] = {}
+        self.journal: List[dict] = []
+        self.journal_bytes = 0             # retained value bytes
+        self.journal_base = 0              # seq of the entry before journal[0]
+        self.last_lease = time.monotonic()
+        self.primary_hint: Optional[str] = (
+            self_addr if role == PRIMARY else None)
+        self.gap_log: List[str] = []
+        self.peers = [_Peer(r) for r in self.replicas
+                      if r != self.self_addr]
+        n = len(self.replicas)
+        self.ack_quorum = (self.config.ack_replicas
+                           if self.config.ack_replicas > 0
+                           else n // 2 + 1)
+        self._stop_evt = threading.Event()
+        self._thread = threading.Thread(target=self._repl_loop,
+                                        name="kv-repl", daemon=True)
+        self._thread.start()
+
+    # -- helpers -------------------------------------------------------------
+
+    @property
+    def standby_index(self) -> int:
+        """This replica's deterministic promotion-stagger index."""
+        return self.replicas.index(self.self_addr)
+
+    def is_primary(self) -> bool:
+        with self._lock:
+            return self.role == PRIMARY
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"role": self.role, "epoch": self.epoch,
+                    "seq": self.seq, "applied_seq": self.applied_seq,
+                    "scope_seq": dict(self.scope_seq),
+                    "journal_len": len(self.journal),
+                    "journal_base": self.journal_base,
+                    "primary": self.primary_hint or "",
+                    "self": self.self_addr, "replicas": list(self.replicas),
+                    "ack_quorum": self.ack_quorum,
+                    "gaps": list(self.gap_log)}
+
+    def audit_journal(self) -> dict:
+        """The promotion-time journal replay, callable any time: walk the
+        retained journal and verify global ``seq`` contiguity and
+        per-scope ``sseq`` contiguity. Returns the audit dict (tests use
+        it as the acked-write-loss proof); gaps are also kept in
+        ``gap_log`` / the ``/_repl/journal`` endpoint."""
+        gaps: List[str] = []
+        if failpoint("kv.journal_gap") is DROP:
+            gaps.append("injected: kv.journal_gap failpoint")
+        with self._lock:
+            entries = list(self.journal)
+            base = self.journal_base
+        prev = base
+        per_scope: Dict[str, int] = {}
+        for e in entries:
+            if e["seq"] != prev + 1:
+                gaps.append(f"global seq gap: {prev} -> {e['seq']}")
+            prev = e["seq"]
+            sprev = per_scope.get(e["scope"])
+            if sprev is not None and e["sseq"] != sprev + 1:
+                gaps.append(f"scope {e['scope']!r} sseq gap: "
+                            f"{sprev} -> {e['sseq']}")
+            per_scope[e["scope"]] = e["sseq"]
+        if gaps:
+            from ..metrics import registry as metrics_registry
+            metrics_registry().counter(
+                "hvd_tpu_kv_journal_gaps_total").inc(len(gaps))
+            with self._lock:
+                self.gap_log.extend(g for g in gaps
+                                    if g not in self.gap_log)
+        return {"base": base, "entries": len(entries), "last": prev,
+                "scopes": per_scope, "gaps": gaps}
+
+    # requires: _lock
+    def _append_journal_locked(self, entry: dict):
+        self.journal.append(entry)
+        self.journal_bytes += len(entry["value"] or b"")
+        cut = max(0, len(self.journal) - self.config.journal_max)
+        trimmed = sum(len(e["value"] or b"") for e in self.journal[:cut])
+        while self.journal_bytes - trimmed > self.config.journal_max_bytes \
+                and cut < len(self.journal) - 1:
+            trimmed += len(self.journal[cut]["value"] or b"")
+            cut += 1
+        if cut:
+            self.journal_bytes -= trimmed
+            self.journal_base = self.journal[cut - 1]["seq"]
+            del self.journal[:cut]
+
+    def stop(self):
+        self._stop_evt.set()
+        if self._thread.is_alive() and \
+                threading.current_thread() is not self._thread:
+            self._thread.join(timeout=5)
+
+    # -- primary: client mutations + replication ----------------------------
+
+    def not_primary_response(self) -> Tuple[int, dict, bytes]:
+        with self._lock:
+            body = json.dumps({"error": "not_primary", "epoch": self.epoch,
+                               "primary": self.primary_hint or ""}).encode()
+        return (CONFLICT, {"X-KV-Not-Primary": "1",
+                           "Content-Type": "application/json"}, body)
+
+    def client_write(self, op: str, scope: str, key: str,
+                     value: Optional[bytes]):
+        """A client mutation arriving at this replica. Primary: journal,
+        apply locally, replicate, ack on quorum. Standby: 409 + hint.
+        Returns the handler response (code or (code, headers, body))."""
+        entry = None
+        with self._lock:
+            if self.role == PRIMARY:
+                self.seq += 1
+                sseq = self.scope_seq[scope] = \
+                    self.scope_seq.get(scope, 0) + 1
+                entry = {"seq": self.seq, "sseq": sseq, "epoch": self.epoch,
+                         "scope": scope, "op": op, "key": key,
+                         "value": value}
+                self._append_journal_locked(entry)
+                target = self.seq
+                # applied INSIDE the journaling lock (nesting order:
+                # coordinator _lock -> server _lock, never reversed): two
+                # concurrent writes to the same key must hit the store in
+                # journal-seq order, or the primary's store could diverge
+                # from every standby's (which apply strictly by seq)
+                existed = self.server._store_apply(op, scope, key, value,
+                                                   seq=entry["seq"],
+                                                   epoch=entry["epoch"])
+        if entry is None:
+            # standby: answer off-lock (not_primary_response re-locks)
+            return self.not_primary_response()
+        acks = 1 + self._replicate(target)
+        if acks < self._effective_quorum():
+            return (UNAVAILABLE, {"Retry-After": "0.2"},
+                    json.dumps({"error": "no_quorum", "acks": acks,
+                                "need": self.ack_quorum}).encode())
+        if op == "delete" and not existed:
+            return 404
+        return OK
+
+    def _effective_quorum(self) -> int:
+        """The ack quorum actually required right now. An explicitly
+        configured ``HOROVOD_KV_ACK_REPLICAS`` is hard; the default
+        (majority of the set) excuses SUSPECT peers — dead replicas —
+        from the denominator, so a 1+1 pair stays writable after either
+        death. Durability is then degraded, loudly (the suspect
+        transition WARNs), never silently."""
+        if self.config.ack_replicas > 0:
+            return self.config.ack_replicas
+        with self._lock:
+            alive = 1 + sum(1 for p in self.peers if not p.suspect)
+        return alive // 2 + 1
+
+    def _record_peer_outcome(self, peer: _Peer, ok: bool):
+        """Suspect-streak accounting; transitions WARN both ways."""
+        changed = None
+        with self._lock:
+            if ok:
+                peer.fail_streak = 0
+                if peer.suspect:
+                    peer.suspect = False
+                    changed = "recovered"
+            else:
+                peer.fail_streak += 1
+                if not peer.suspect and peer.fail_streak >= SUSPECT_AFTER:
+                    peer.suspect = True
+                    changed = "suspect"
+        if changed == "suspect":
+            logger.warning(
+                "KV replica %s unreachable (%d consecutive failures) — "
+                "excused from the ack quorum; writes are DEGRADED to "
+                "fewer replicas until it recovers", peer.addr,
+                peer.fail_streak)
+        elif changed == "recovered":
+            logger.warning("KV replica %s recovered — full ack quorum "
+                           "restored", peer.addr)
+
+    def _replicate(self, target_seq: int,
+                   deadline: Optional[float] = None) -> int:
+        """Bring every peer up to ``target_seq``; returns how many peers
+        confirmed. Demotes this node if a peer fences us (newer epoch)."""
+        acks = 0
+        for peer in self.peers:
+            try:
+                if self._sync_peer(peer, target_seq, deadline):
+                    acks += 1
+                    self._record_peer_outcome(peer, True)
+                else:
+                    self._record_peer_outcome(peer, False)
+            except _Fenced as f:
+                self._observe_epoch(f.epoch, f.primary)
+                break
+            except Exception as e:
+                self._record_peer_outcome(peer, False)
+                logger.debug("replication to %s failed: %s", peer.addr, e)
+        return acks
+
+    def _post(self, peer: _Peer, key: str, payload: dict,
+              timeout: float) -> dict:
+        req = urllib.request.Request(
+            f"http://{peer.host}:{peer.port}/{REPL_SCOPE}/{key}",
+            data=json.dumps(payload).encode(), method="PUT")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            if e.code == PRECONDITION_FAILED:
+                info = {}
+                try:
+                    info = json.loads(e.read() or b"{}")
+                except Exception:
+                    pass
+                raise _Fenced(int(info.get("epoch", 0)),
+                              info.get("primary") or None)
+            if e.code == CONFLICT:
+                info = json.loads(e.read() or b"{}")
+                raise _ApplyGap(int(info.get("applied", -1)))
+            raise
+
+    def _sync_peer(self, peer: _Peer, target_seq: int,
+                   deadline: Optional[float] = None,
+                   heartbeat: bool = False) -> bool:
+        """Stream journal entries (or a snapshot, when the peer is behind
+        the retained journal or diverged) to one peer until it has applied
+        ``target_seq``. Serialized per peer; ordering comes from the
+        journal, so concurrent writers simply find their entry already
+        shipped by whoever got the send lock first.
+
+        ``heartbeat`` (the lease loop) forces an empty apply even when the
+        peer is fully caught up — an IDLE primary must keep refreshing the
+        standbys' lease or a quiet control plane (no writes for a lease
+        grace) would spuriously promote its standby and flip-flop roles."""
+        failpoint("kv.replicate")
+        timeout = max(self.config.lease_interval, 0.25)
+        if deadline is not None:
+            timeout = max(min(timeout, deadline - time.monotonic()), 0.05)
+        with peer.send_lock:
+            with self._lock:
+                acked = peer.acked
+                epoch = self.epoch
+            if acked is None or (heartbeat and acked >= target_seq):
+                # probe/lease: an empty apply refreshes the peer's lease
+                # and returns its applied seq
+                resp = self._post(peer, "apply",
+                                  {"epoch": epoch, "base": None,
+                                   "primary": self.self_addr,
+                                   "entries": []}, timeout)
+                acked = int(resp.get("applied", -1))
+                with self._lock:
+                    peer.acked = acked
+            if acked >= target_seq:
+                return True
+            with self._lock:
+                floor = self.journal_base
+                entries = [e for e in self.journal if e["seq"] > acked]
+            if acked < floor or acked < 0:
+                self._push_snapshot(peer, timeout)
+                with self._lock:
+                    acked = peer.acked if peer.acked is not None else -1
+                    entries = [e for e in self.journal if e["seq"] > acked]
+            try:
+                resp = self._post(peer, "apply", {
+                    "epoch": epoch, "base": acked,
+                    "primary": self.self_addr,
+                    "entries": [{**e, "value": _b64e(e["value"])}
+                                for e in entries]}, timeout)
+            except _ApplyGap as g:
+                # the peer's applied moved under us (or it diverged):
+                # adopt its word and retry once via snapshot
+                with self._lock:
+                    peer.acked = g.applied if g.applied >= 0 else None
+                self._push_snapshot(peer, timeout)
+                resp = self._post(peer, "apply",
+                                  {"epoch": epoch, "base": None,
+                                   "primary": self.self_addr,
+                                   "entries": []}, timeout)
+            applied = int(resp.get("applied", -1))
+            with self._lock:
+                peer.acked = applied
+            if entries:
+                from ..metrics import registry as metrics_registry
+                metrics_registry().counter(
+                    "hvd_tpu_kv_repl_entries_total").inc(len(entries))
+            return applied >= target_seq
+
+    def _push_snapshot(self, peer: _Peer, timeout: float):
+        """Full-state resync: ships the whole store + seq counters. Used
+        for peers behind the retained journal, fresh standbys, and
+        demoted ex-primaries (applied_seq == -1).
+
+        Ordering matters: the claimed ``seq`` is read BEFORE the store
+        copy. A concurrent write journaled after the seq read may already
+        be in the store copy (harmless — the peer re-applies its entry
+        idempotently), but a snapshot could never claim a seq whose write
+        it does not contain — that would manufacture a false ack and lose
+        an acked write across a later promotion."""
+        with self._lock:
+            seq = self.seq
+            epoch = self.epoch
+            scope_seq = dict(self.scope_seq)
+        store = self.server.snapshot()
+        payload = {"epoch": epoch, "seq": seq, "scope_seq": scope_seq,
+                   "primary": self.self_addr,
+                   "store": {scope: {k: _b64e(v) for k, v in kv.items()}
+                             for scope, kv in store.items()}}
+        resp = self._post(peer, "snapshot", payload,
+                          max(timeout, 1.0))
+        with self._lock:
+            peer.acked = int(resp.get("applied", -1))
+
+    # -- standby: apply / promote -------------------------------------------
+
+    def handle_control(self, key: str, body: bytes):
+        """``PUT /_repl/<key>`` dispatch (apply | snapshot). Returns the
+        handler response tuple."""
+        try:
+            msg = json.loads(body or b"{}")
+        except ValueError:
+            return (400, {}, b'{"error": "bad json"}')
+        if key == "apply":
+            return self._handle_apply(msg)
+        if key == "snapshot":
+            return self._handle_snapshot(msg)
+        return 404
+
+    def _replica_index(self, addr: Optional[str]) -> int:
+        """Position of a replica in the configured set; unknown addrs sort
+        last (they can never win a tie)."""
+        try:
+            return self.replicas.index(addr)
+        except ValueError:
+            return len(self.replicas)
+
+    def _fence_or_adopt(self, msg_epoch: int, primary: Optional[str]):
+        """Common epoch discipline, caller holds NO locks. Returns a fence
+        response tuple for stale senders, None when the message may
+        proceed. Newer epochs are adopted (demoting a primary); an EQUAL
+        epoch claimed by two primaries (both standbys of a dead root
+        promoted inside the same window) is tie-broken by replica-set
+        index — the lower index wins, deterministically, so a dual-primary
+        split can never persist."""
+        with self._lock:
+            stale = msg_epoch < self.epoch or (
+                msg_epoch == self.epoch and self.role == PRIMARY and
+                primary and primary != self.self_addr and
+                self._replica_index(primary) >
+                self._replica_index(self.self_addr))
+            if stale:
+                from ..metrics import registry as metrics_registry
+                metrics_registry().counter(
+                    "hvd_tpu_kv_fenced_writes_total").inc()
+                body = json.dumps({"error": "stale_epoch",
+                                   "epoch": self.epoch,
+                                   "primary": self.primary_hint or ""})
+                return (PRECONDITION_FAILED,
+                        {"Content-Type": "application/json"}, body.encode())
+        if msg_epoch > 0:
+            self._observe_epoch(msg_epoch, primary)
+        return None
+
+    def _handle_apply(self, msg: dict):
+        fence = self._fence_or_adopt(int(msg.get("epoch", 0)),
+                                     msg.get("primary"))
+        if fence is not None:
+            return fence
+        entries = msg.get("entries") or []
+        with self._lock:
+            self.last_lease = time.monotonic()
+            if msg.get("primary"):
+                self.primary_hint = msg["primary"]
+            if self.applied_seq < 0 and entries:
+                # diverged (demoted ex-primary): only a snapshot resync
+                # may re-seed the store
+                return (CONFLICT, {"Content-Type": "application/json"},
+                        json.dumps({"applied": -1,
+                                    "need_snapshot": True}).encode())
+            base = msg.get("base")
+            if entries:
+                if base is None or int(base) > self.applied_seq:
+                    return (CONFLICT, {"Content-Type": "application/json"},
+                            json.dumps(
+                                {"applied": self.applied_seq}).encode())
+                to_apply = [e for e in entries
+                            if int(e["seq"]) > self.applied_seq]
+            else:
+                to_apply = []
+        for e in to_apply:
+            value = _b64d(e.get("value"))
+            entry = {"seq": int(e["seq"]), "sseq": int(e["sseq"]),
+                     "epoch": int(e["epoch"]), "scope": e["scope"],
+                     "op": e["op"], "key": e["key"], "value": value}
+            with self._lock:
+                if entry["seq"] != self.applied_seq + 1:
+                    return (CONFLICT,
+                            {"Content-Type": "application/json"},
+                            json.dumps(
+                                {"applied": self.applied_seq}).encode())
+                self._append_journal_locked(entry)
+                self.applied_seq = entry["seq"]
+                self.seq = max(self.seq, entry["seq"])
+                self.scope_seq[entry["scope"]] = entry["sseq"]
+                # same nesting discipline as client_write: store mutation
+                # in journal order, under the coordinator lock
+                self.server._store_apply(entry["op"], entry["scope"],
+                                         entry["key"], entry["value"],
+                                         seq=entry["seq"],
+                                         epoch=entry["epoch"])
+        with self._lock:
+            applied = self.applied_seq
+        return (OK, {"Content-Type": "application/json"},
+                json.dumps({"applied": applied}).encode())
+
+    def _handle_snapshot(self, msg: dict):
+        fence = self._fence_or_adopt(int(msg.get("epoch", 0)),
+                                     msg.get("primary"))
+        if fence is not None:
+            return fence
+        store = {scope: {k: _b64d(v) for k, v in kv.items()}
+                 for scope, kv in (msg.get("store") or {}).items()}
+        seq = int(msg.get("seq", 0))
+        with self._lock:
+            # snapshot install is atomic with the seq counters (the same
+            # coordinator->server nesting as the per-entry applies): a
+            # racing apply must never interleave with a half-installed
+            # store
+            self.server._store_replace(store, seq=seq,
+                                       epoch=int(msg.get("epoch", 0)))
+            self.applied_seq = seq
+            self.seq = max(self.seq, seq)
+            self.scope_seq = {k: int(v) for k, v in
+                              (msg.get("scope_seq") or {}).items()}
+            self.journal = []
+            self.journal_bytes = 0
+            self.journal_base = seq
+            self.last_lease = time.monotonic()
+            if msg.get("primary"):
+                self.primary_hint = msg["primary"]
+        logger.info("replica %s resynced from snapshot (seq %d)",
+                    self.self_addr, seq)
+        return (OK, {"Content-Type": "application/json"},
+                json.dumps({"applied": seq}).encode())
+
+    def _observe_epoch(self, epoch: int, primary: Optional[str]):
+        """Adopt a newer epoch seen on the wire; a primary seeing one has
+        been fenced and demotes itself (resync via snapshot on the new
+        primary's next contact)."""
+        demoted = False
+        with self._lock:
+            if epoch < self.epoch:
+                return
+            if epoch == self.epoch:
+                if self.role != PRIMARY:
+                    if primary:
+                        self.primary_hint = primary
+                    return
+                # equal-epoch dual primary (simultaneous promotions):
+                # the lower replica-set index wins; we lose only to it
+                if not primary or primary == self.self_addr or \
+                        self._replica_index(primary) >= \
+                        self._replica_index(self.self_addr):
+                    return
+            if self.role == PRIMARY:
+                demoted = True
+                self.role = STANDBY
+                # local journal may hold unreplicated (hence unacked)
+                # writes the new primary never saw: mark diverged so the
+                # next contact resyncs the whole store
+                self.applied_seq = -1
+            self.epoch = epoch
+            self.last_lease = time.monotonic()
+            if primary:
+                self.primary_hint = primary
+        if demoted:
+            logger.warning(
+                "KV replica %s: fenced at epoch %d (new primary %s) — "
+                "demoted to standby, store marked for resync; locally "
+                "journaled unacked writes are discarded (they never "
+                "reached quorum, so no client saw them acked)",
+                self.self_addr, epoch, primary)
+
+    def promote(self, reason: str = "manual"):
+        """Standby -> primary: replay/audit the journal, bump the epoch,
+        start streaming to the remaining replicas. Gap detection is loud
+        (ERROR + ``hvd_tpu_kv_journal_gaps_total``) but does not refuse
+        the promotion — an acked write cannot sit in a gap (this replica
+        acked everything it applied), so availability wins."""
+        failpoint("kv.promote")
+        audit = self.audit_journal()
+        with self._lock:
+            if self.role == PRIMARY:
+                return
+            self.role = PRIMARY
+            self.epoch += 1
+            self.seq = max(self.seq, self.applied_seq)
+            if self.applied_seq < 0:
+                self.applied_seq = self.seq
+            self.primary_hint = self.self_addr
+            epoch = self.epoch
+            seq = self.seq
+            for peer in self.peers:
+                peer.acked = None          # probe each on next contact
+        from ..metrics import registry as metrics_registry
+        metrics_registry().counter("hvd_tpu_kv_promotions_total").inc()
+        if audit["gaps"]:
+            logger.error(
+                "KV standby %s promoting with journal gaps %s — these can "
+                "only contain never-acked writes (this replica acked "
+                "everything it applied), but the stream that produced them "
+                "was torn", self.self_addr, audit["gaps"])
+        logger.warning(
+            "KV standby %s promoted to primary (epoch %d, seq %d, %s); "
+            "journal audit: %d entries from base %d, %d gap(s)",
+            self.self_addr, epoch, seq, reason, audit["entries"],
+            audit["base"], len(audit["gaps"]))
+
+    # -- background loop -----------------------------------------------------
+
+    def _repl_loop(self):
+        """Primary: lease/catch-up stream to every peer. Standby: promote
+        when the lease has been silent past the staggered timeout."""
+        while True:
+            with self._lock:
+                role = self.role
+                target = self.seq
+                lease_age = time.monotonic() - self.last_lease
+            interval = (self.config.lease_interval if role == PRIMARY
+                        else min(self.config.lease_interval,
+                                 self.config.lease_timeout / 4.0))
+            if role == PRIMARY:
+                for peer in self.peers:
+                    try:
+                        ok = self._sync_peer(peer, target, heartbeat=True)
+                        self._record_peer_outcome(peer, ok)
+                    except _Fenced as f:
+                        self._observe_epoch(f.epoch, f.primary)
+                        break
+                    except Exception as e:
+                        self._record_peer_outcome(peer, False)
+                        logger.debug("lease/catch-up to %s failed: %s",
+                                     peer.addr, e)
+            else:
+                grace = self.config.lease_timeout * (1 + self.standby_index)
+                if lease_age > grace:
+                    try:
+                        self.promote(reason=f"lease silent {lease_age:.2f}s "
+                                            f"(> {grace:.2f}s)")
+                    except Exception as e:
+                        logger.error("automatic promotion failed: %s", e)
+            if self._stop_evt.wait(interval):
+                return
+
+
+class _Fenced(Exception):
+    """A peer rejected our epoch (PRECONDITION_FAILED): we are a zombie."""
+
+    def __init__(self, epoch: int, primary: Optional[str]):
+        super().__init__(f"fenced by epoch {epoch} (primary {primary})")
+        self.epoch = epoch
+        self.primary = primary
+
+
+class _ApplyGap(Exception):
+    """A peer's applied seq does not meet our base (CONFLICT)."""
+
+    def __init__(self, applied: int):
+        super().__init__(f"apply gap (peer applied {applied})")
+        self.applied = applied

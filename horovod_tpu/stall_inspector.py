@@ -176,7 +176,8 @@ class StallInspector:
     # -- cross-rank attribution via the rendezvous KV -----------------------
 
     def _publish(self):
-        from .runner.http_client import put_data_into_kvstore
+        from .runner.http_client import (KVBackpressure, count_shed_bytes,
+                                         put_data_into_kvstore)
         now = time.monotonic()
         with self._lock:
             # Publish only tensors already stale locally: an op merely in
@@ -199,10 +200,17 @@ class StallInspector:
             # one in-call retry (retries=1): publishes are periodic, so a
             # long backoff would just delay the next tick — the streak
             # logic above owns persistent-outage escalation
-            put_data_into_kvstore(self.kv[0], self.kv[1], KV_SCOPE,
-                                  str(self.rank),
-                                  json.dumps(payload).encode(), timeout=5,
-                                  retries=1)
+            encoded = json.dumps(payload).encode()
+            try:
+                put_data_into_kvstore(self.kv[0], self.kv[1], KV_SCOPE,
+                                      str(self.rank), encoded, timeout=5,
+                                      retries=1)
+            except KVBackpressure:
+                # deliberate server shedding (scope byte budget) — not an
+                # outage: count the shed bytes, skip this tick, and leave
+                # the failure streak alone (the server is alive)
+                count_shed_bytes(KV_SCOPE, len(encoded))
+                return
         except Exception as e:
             self._pub_fail_streak += 1
             self._m_pub_failures.inc()

@@ -271,15 +271,23 @@ def publish_segment(kv: Tuple[str, int], rank: int, segment,
     silently-dropped publish is injectable (the chaos suite proves the
     merged ``/trace`` degrades gracefully instead of failing)."""
     from .faults import DROP, failpoint
-    from .runner.http_client import put_data_into_kvstore
+    from .runner.http_client import (KVBackpressure, count_shed_bytes,
+                                     put_data_into_kvstore)
     if failpoint("trace.publish") is DROP:
         return
     if isinstance(segment, str):
         segment = segment.encode()
     elif not isinstance(segment, (bytes, bytearray)):
         segment = json.dumps(segment).encode()
-    put_data_into_kvstore(kv[0], kv[1], TRACE_KV_SCOPE, str(rank),
-                          segment, timeout=timeout, retries=1)
+    try:
+        put_data_into_kvstore(kv[0], kv[1], TRACE_KV_SCOPE, str(rank),
+                              segment, timeout=timeout, retries=1)
+    except KVBackpressure:
+        # server backpressure (scope byte budget): shed this segment —
+        # the ring already drops oldest-first, so the loss is the oldest
+        # spans, and the next publish carries the newest window — and
+        # count the degradation (never block the publisher thread)
+        count_shed_bytes(TRACE_KV_SCOPE, len(segment))
 
 
 class TracePublisher(threading.Thread):

@@ -53,8 +53,9 @@ def kv_server():
 
 
 def _kv_state(server) -> dict:
-    with server._lock:
-        return {scope: dict(kv) for scope, kv in server._store.items()}
+    # the public consistent-copy surface (ISSUE 12 satellite) — tests no
+    # longer reach into server._lock/_store privates
+    return server.snapshot()
 
 
 # ---------------------------------------------------------------------------

@@ -431,8 +431,7 @@ class TestKVTransfer:
         with pytest.raises(TimeoutError):
             read_large_value(*kv, "ckptshard", "g1.r0", timeout=0.5)
         # server-side store is clean of chunk keys too
-        with kv_server._lock:
-            assert not kv_server._store.get("ckptshard")
+        assert not kv_server.snapshot().get("ckptshard")
 
     def test_read_retries_torn_write(self, kv_server):
         """Meta present but a chunk inconsistent (torn interleaving):
@@ -492,8 +491,7 @@ class TestKVTransfer:
         _write_world(str(tmp_path), tree, n=2, kv=kv)
         # wipe the KV (a restarted rendezvous server after preemption)
         # and rank 1's disk
-        with kv_server._lock:
-            kv_server._store.clear()
+        kv_server.clear_all()
         shutil.rmtree(tmp_path / "rank1")
         # rank 1 restores into a PRIVATE directory: its only route to
         # shard 1 is rank 0's replica via the KV

@@ -1,0 +1,809 @@
+"""Replicated control plane (ISSUE 12): endpoint-set client + circuit
+breaker, journaled replication with quorum acks, lease/epoch promotion,
+fencing, backpressure — and the chaos proofs that SIGKILL of the primary
+KV root costs a sub-second failover, never the fleet.
+
+Two tiers:
+
+- unit tests (fast, in-process): endpoint parsing/breaker/redirect rules,
+  server snapshot/backpressure surfaces, replication/ack/fencing semantics,
+  journal audit + the new failpoints;
+- ``chaos``-marked tests that really ``SIGKILL`` a subprocess primary
+  mid-elastic-registration, mid-chunked-shard-upload, and mid-long-poll —
+  each must complete through the promoted standby with no acked-write loss
+  (verified by the journal sequence audit), plus the acceptance run: an
+  elastic training loop whose telemetry rides a 1-primary/1-standby
+  control plane survives the root kill with failover counters visible in
+  the standby's scrape.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import faults
+from horovod_tpu.metrics import publish_snapshot, registry
+from horovod_tpu.runner.http_client import (Endpoints, KVBackpressure,
+                                            parse_endpoint_spec,
+                                            put_data_into_kvstore,
+                                            put_large_value,
+                                            read_data_from_kvstore,
+                                            read_large_value,
+                                            resolve_endpoints)
+from horovod_tpu.runner.http_server import KVStoreServer, find_free_port
+from horovod_tpu.runner.replication import ReplicationConfig
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# fast-promotion settings every in-process pair in this file uses
+FAST = dict(lease_timeout=0.3, lease_interval=0.1)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+def _pair(role_b="standby", cfg=None, cfg_b=None):
+    """An in-process primary+standby pair on fixed free ports. Returns
+    (server_a, server_b, endpoints, replica_specs)."""
+    p1, p2 = find_free_port(), find_free_port()
+    a = KVStoreServer(("127.0.0.1", p1))
+    b = KVStoreServer(("127.0.0.1", p2))
+    a.start()
+    b.start()
+    reps = [f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"]
+    a.enable_replication(reps[0], reps, role="primary",
+                         config=cfg or ReplicationConfig(**FAST))
+    b.enable_replication(reps[1], reps, role=role_b,
+                         config=cfg_b or cfg or ReplicationConfig(**FAST))
+    eps = Endpoints([("127.0.0.1", p1), ("127.0.0.1", p2)],
+                    trip_failures=3, reset_delay=0.1)
+    return a, b, eps, reps
+
+
+# ---------------------------------------------------------------------------
+# Endpoint set + circuit breaker (client tier)
+# ---------------------------------------------------------------------------
+
+class TestEndpoints:
+    def test_spec_parsing_forms(self):
+        assert parse_endpoint_spec("h1:1,h2:2") == (("h1", 1), ("h2", 2))
+        assert parse_endpoint_spec("h1", default_port=7) == (("h1", 7),)
+        with pytest.raises(ValueError):
+            parse_endpoint_spec("h1", default_port=None)
+        with pytest.raises(ValueError):
+            parse_endpoint_spec("")
+
+    def test_resolve_accepts_legacy_tuple_as_addr(self):
+        """The documented legacy form: the whole ('host', port) tuple in
+        the addr position (arm_from_kv callers) resolves to the same
+        shared single-endpoint set, not a pairs-list unpack crash."""
+        a = resolve_endpoints(("127.0.0.1", 12347))
+        assert a.pairs == (("127.0.0.1", 12347),)
+        assert resolve_endpoints("127.0.0.1", 12347) is a
+
+    def test_resolve_is_shared_and_stateful(self):
+        """Callers passing raw (addr, port) tuples every call must land on
+        the SAME Endpoints, so breaker state survives stateless call
+        sites; every accepted form of the same pair set aliases it."""
+        a = resolve_endpoints("127.0.0.1", 12345)
+        b = resolve_endpoints("127.0.0.1:12345", None)
+        c = resolve_endpoints([("127.0.0.1", 12345)])
+        assert a is b is c
+        assert resolve_endpoints(a) is a
+        d = resolve_endpoints("127.0.0.1:12345,127.0.0.1:12346")
+        assert d is not a and len(d) == 2
+
+    def test_breaker_trips_and_half_opens(self):
+        eps = Endpoints([("h1", 1), ("h2", 2)], trip_failures=2,
+                        reset_delay=0.1)
+        assert eps.candidates() == [0, 1]
+        eps.record_failure(0)
+        assert eps.candidates() == [0, 1]      # below the trip threshold
+        eps.record_failure(0)                  # trips open
+        assert eps.candidates() == [1, 0]      # open sorts last, not skipped
+        # past the reopen delay the breaker half-opens: the endpoint is a
+        # plain candidate again (one probe), and a success closes it
+        time.sleep(0.35)
+        assert eps.candidates()[0] == 0        # preferred again (half-open)
+        eps.record_success(0)
+        assert eps.candidates() == [0, 1]
+
+    def test_all_open_still_served(self):
+        """With every breaker tripped there is nothing better to try:
+        candidates() serves the full set anyway (ordered by soonest
+        reopen — jittered, so only membership is asserted)."""
+        eps = Endpoints([("h1", 1), ("h2", 2)], trip_failures=1,
+                        reset_delay=5.0)
+        eps.record_failure(0)
+        eps.record_failure(1)
+        assert sorted(eps.candidates()) == [0, 1]
+
+    def test_redirect_is_epoch_aware(self):
+        eps = Endpoints([("h1", 1), ("h2", 2)])
+        assert eps.record_redirect("h2:2", epoch=3) == 1
+        assert eps.candidates()[0] == 1
+        # a zombie's stale hint (older epoch) must not steal it back
+        assert eps.record_redirect("h1:1", epoch=2) is None
+        assert eps.candidates()[0] == 1
+        # unknown hints never grow the frozen set
+        assert eps.record_redirect("h9:9", epoch=9) is None
+
+    def test_success_on_standby_read_does_not_steal_preference(self):
+        eps = Endpoints([("h1", 1), ("h2", 2)])
+        eps.record_redirect("h2:2", epoch=1)
+        eps.record_success(0, prefer=False)    # a GET served by h1
+        assert eps.candidates()[0] == 1
+
+
+# ---------------------------------------------------------------------------
+# Server surfaces: snapshot + backpressure
+# ---------------------------------------------------------------------------
+
+class TestServerSurfaces:
+    def test_snapshot_is_consistent_copy(self):
+        s = KVStoreServer(("127.0.0.1", 0))
+        s.start()
+        try:
+            put_data_into_kvstore("127.0.0.1", s.port, "a", "k", b"v")
+            snap = s.snapshot()
+            assert snap == {"a": {"k": b"v"}}
+            snap["a"]["k"] = b"mutated"        # a COPY, not the live store
+            assert s.snapshot()["a"]["k"] == b"v"
+            s.clear_all()
+            assert s.snapshot() == {}
+        finally:
+            s.stop()
+
+    def test_backpressure_429_and_retry_after(self):
+        reg = registry()
+        s = KVStoreServer(("127.0.0.1", 0))
+        s.start()
+        s.set_scope_budget("metrics", 10)
+        bp_before = reg.counter("hvd_tpu_kv_backpressure_total").value(
+            scope="metrics")
+        try:
+            put_data_into_kvstore("127.0.0.1", s.port, "metrics", "0",
+                                  b"12345678", timeout=5)
+            with pytest.raises(KVBackpressure) as ei:
+                put_data_into_kvstore("127.0.0.1", s.port, "metrics", "1",
+                                      b"12345678", timeout=5)
+            assert ei.value.retry_after > 0
+            assert reg.counter("hvd_tpu_kv_backpressure_total").value(
+                scope="metrics") == bp_before + 1
+            # same-key overwrite that shrinks (or holds) the scope always
+            # passes — a last-writer-wins publisher can't wedge itself
+            put_data_into_kvstore("127.0.0.1", s.port, "metrics", "0",
+                                  b"1234", timeout=5)
+            # other scopes are unaffected
+            put_data_into_kvstore("127.0.0.1", s.port, "other", "k",
+                                  b"x" * 64, timeout=5)
+        finally:
+            s.stop()
+
+    def test_backpressure_is_not_retried(self):
+        """KVBackpressure is not an OSError: the retry machinery must not
+        hammer a server that asked for shedding."""
+        reg = registry()
+        s = KVStoreServer(("127.0.0.1", 0))
+        s.start()
+        s.set_scope_budget("sc", 4)
+        retries_before = reg.counter("hvd_tpu_kv_retries_total").total()
+        try:
+            with pytest.raises(KVBackpressure):
+                put_data_into_kvstore("127.0.0.1", s.port, "sc", "k",
+                                      b"way too big", timeout=5, retries=3)
+            assert reg.counter("hvd_tpu_kv_retries_total").total() \
+                == retries_before
+        finally:
+            s.stop()
+
+    def test_publishers_shed_oldest_first_not_block(self):
+        """The metrics/trace/stall publishers honor 429 by shedding (the
+        ring/last-writer-wins semantics make the loss oldest-first) and
+        counting hvd_tpu_kv_shed_bytes_total — never raising, never
+        blocking the step path."""
+        from horovod_tpu.stall_inspector import StallInspector
+        from horovod_tpu.trace import publish_segment
+        reg = registry()
+        s = KVStoreServer(("127.0.0.1", 0))
+        s.start()
+        for scope in ("metrics", "trace", "stall"):
+            s.set_scope_budget(scope, 8)
+        kv = ("127.0.0.1", s.port)
+        shed = lambda sc: reg.counter("hvd_tpu_kv_shed_bytes_total").value(
+            scope=sc)
+        before = {sc: shed(sc) for sc in ("metrics", "trace", "stall")}
+        try:
+            publish_snapshot(kv, 0, {"enabled": True,
+                                     "counters": {"x": 1}})   # > 8 bytes
+            assert shed("metrics") > before["metrics"]
+            publish_segment(kv, 0, b"{" + b"x" * 64 + b"}")
+            assert shed("trace") > before["trace"]
+            insp = StallInspector(warning_seconds=1e9, check_interval=1e3,
+                                  kv=kv, rank=0, size=2)
+            try:
+                insp._publish()
+                assert shed("stall") > before["stall"]
+                # deliberate shedding is not an outage: no failure streak
+                assert insp._pub_fail_streak == 0
+            finally:
+                insp.stop()
+            assert s.snapshot() == {}          # nothing landed, by design
+        finally:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# Replication semantics (in-process pair)
+# ---------------------------------------------------------------------------
+
+class TestReplication:
+    def test_acked_write_visible_on_standby(self):
+        a, b, eps, _ = _pair()
+        try:
+            put_data_into_kvstore(eps, None, "reg", "k", b"v", timeout=10)
+            # quorum-acked means applied on the standby BEFORE the ack
+            assert b.snapshot()["reg"]["k"] == b"v"
+            assert a.replication.status()["role"] == "primary"
+            assert b.replication.status()["applied_seq"] == 1
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_standby_redirects_writes_to_primary(self):
+        """A client whose endpoint set lists the standby FIRST still
+        lands its write on the primary via the 409 hint."""
+        a, b, _, reps = _pair()
+        backwards = Endpoints([("127.0.0.1", b.port),
+                               ("127.0.0.1", a.port)], reset_delay=0.1)
+        try:
+            put_data_into_kvstore(backwards, None, "sc", "k", b"v",
+                                  timeout=10)
+            assert a.snapshot()["sc"]["k"] == b"v"
+            assert b.snapshot()["sc"]["k"] == b"v"
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_standby_budget_never_terminal_429s_a_redirect(self):
+        """The budget is the PRIMARY's to enforce: a standby with a
+        local/stale budget must redirect (409) rather than answer 429 —
+        KVBackpressure is deliberately terminal for the client, and a
+        standby-first endpoint order must not turn an acceptable write
+        into a refusal."""
+        a, b, _, _ = _pair()
+        b.set_scope_budget("ckptshard", 4)     # standby-local budget
+        backwards = Endpoints([("127.0.0.1", b.port),
+                               ("127.0.0.1", a.port)], reset_delay=0.1)
+        try:
+            put_data_into_kvstore(backwards, None, "ckptshard", "g1.c0",
+                                  b"x" * 64, timeout=10)
+            assert a.snapshot()["ckptshard"]["g1.c0"] == b"x" * 64
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_standby_serves_long_poll_reads(self):
+        a, b, eps, _ = _pair()
+        standby_only = Endpoints([("127.0.0.1", b.port)], reset_delay=0.1)
+        got = {}
+
+        def _reader():
+            got["v"] = read_data_from_kvstore(standby_only, None, "sc",
+                                              "late", timeout=10,
+                                              poll_interval=0.05)
+
+        t = threading.Thread(target=_reader)
+        t.start()
+        try:
+            time.sleep(0.2)
+            put_data_into_kvstore(eps, None, "sc", "late", b"polled",
+                                  timeout=10)
+            t.join(timeout=10)
+            assert got.get("v") == b"polled"
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_delete_and_clear_replicate(self):
+        a, b, eps, _ = _pair()
+        try:
+            put_data_into_kvstore(eps, None, "sc", "k", b"v", timeout=10)
+            from horovod_tpu.runner.http_client import \
+                delete_data_from_kvstore
+            delete_data_from_kvstore(eps, None, "sc", "k", timeout=10)
+            assert "k" not in b.snapshot().get("sc", {})
+            put_data_into_kvstore(eps, None, "trace", "0", b"x", timeout=10)
+            a.clear_scope("trace")
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and \
+                    b.snapshot().get("trace"):
+                time.sleep(0.05)
+            assert not b.snapshot().get("trace")
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_replicate_failpoint_degrades_quorum_loudly(self, caplog):
+        """kv.replicate=*raise models a dead standby link: writes degrade
+        to fewer replicas after the suspect streak — loudly — instead of
+        blocking forever (the 1+1 availability rule). An explicit
+        HOROVOD_KV_ACK_REPLICAS stays a hard requirement."""
+        import logging
+        a, b, eps, _ = _pair()
+        try:
+            faults.arm("kv.replicate=*raise(ConnectionError)")
+            with caplog.at_level(logging.WARNING,
+                                 logger="horovod_tpu.runner"):
+                put_data_into_kvstore(eps, None, "sc", "k", b"v",
+                                      timeout=20)
+            assert a.snapshot()["sc"]["k"] == b"v"
+            assert "k" not in b.snapshot().get("sc", {})  # never replicated
+            assert any("DEGRADED" in r.message for r in caplog.records)
+            assert faults.hits("kv.replicate") >= 3
+        finally:
+            faults.disarm()
+            a.stop()
+            b.stop()
+
+    def test_strict_ack_replicas_never_degrades(self):
+        cfg = ReplicationConfig(ack_replicas=2, **FAST)
+        a, b, eps, _ = _pair(cfg=cfg)
+        try:
+            faults.arm("kv.replicate=*raise(ConnectionError)")
+            with pytest.raises((OSError, TimeoutError)):
+                put_data_into_kvstore(eps, None, "sc", "k", b"v",
+                                      timeout=3)
+        finally:
+            faults.disarm()
+            a.stop()
+            b.stop()
+
+    def test_fencing_rejects_zombie_and_demotes_it(self):
+        """The fencing proof: the old primary comes back (here: never
+        died, just got leapfrogged by a manual promotion) and its
+        stale-epoch stream is rejected; it demotes itself, resyncs, and
+        the acked write lands through the new primary."""
+        reg = registry()
+        cfg = ReplicationConfig(lease_timeout=60, lease_interval=0.1)
+        a, b, eps, reps = _pair(cfg=cfg)
+        fenced_before = reg.counter("hvd_tpu_kv_fenced_writes_total").total()
+        promo_before = reg.counter("hvd_tpu_kv_promotions_total").total()
+        try:
+            put_data_into_kvstore(eps, None, "sc", "pre", b"1", timeout=10)
+            b.replication.promote()            # epoch 2; A is a zombie now
+            assert reg.counter("hvd_tpu_kv_promotions_total").total() \
+                == promo_before + 1
+            # the write first hits the zombie (sticky preference), which
+            # cannot ack (fenced by B) — the client lands it on B
+            put_data_into_kvstore(eps, None, "sc", "fenced", b"2",
+                                  timeout=15)
+            assert b.snapshot()["sc"]["fenced"] == b"2"
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and \
+                    a.replication.status()["role"] != "standby":
+                time.sleep(0.05)
+            st = a.replication.status()
+            assert st["role"] == "standby" and st["epoch"] == 2
+            assert reg.counter("hvd_tpu_kv_fenced_writes_total").total() \
+                > fenced_before
+            # the demoted zombie resyncs the acked state from B's stream
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and \
+                    a.snapshot().get("sc", {}).get("fenced") != b"2":
+                time.sleep(0.05)
+            assert a.snapshot()["sc"]["fenced"] == b"2"
+            # and a raw stale-epoch apply is refused with 412
+            import urllib.error
+            import urllib.request
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{b.port}/_repl/apply",
+                data=json.dumps({"epoch": 1, "base": None, "entries": [],
+                                 "primary": reps[0]}).encode(),
+                method="PUT")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 412
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_promotion_audits_journal_and_counts_gaps(self):
+        reg = registry()
+        cfg = ReplicationConfig(lease_timeout=60, lease_interval=0.1)
+        a, b, eps, _ = _pair(cfg=cfg)
+        gaps_before = reg.counter("hvd_tpu_kv_journal_gaps_total").total()
+        try:
+            for i in range(4):
+                put_data_into_kvstore(eps, None, "reg", f"k{i}",
+                                      f"v{i}".encode(), timeout=10)
+            audit = b.replication.audit_journal()
+            assert audit["gaps"] == [] and audit["entries"] == 4
+            # the kv.journal_gap failpoint injects a synthetic gap, so the
+            # detection path (count + promote-time ERROR) is provable
+            faults.arm("kv.journal_gap=1*drop()")
+            audit = b.replication.audit_journal()
+            assert audit["gaps"] and "injected" in audit["gaps"][0]
+            assert reg.counter("hvd_tpu_kv_journal_gaps_total").total() \
+                > gaps_before
+            faults.disarm()
+            # kv.promote fires on the promotion edge
+            faults.arm("kv.promote=1*noop()")
+            b.replication.promote()
+            assert faults.hits("kv.promote") == 1
+            assert b.replication.status()["role"] == "primary"
+        finally:
+            faults.disarm()
+            a.stop()
+            b.stop()
+
+    def test_idle_primary_keeps_lease_alive(self):
+        """An IDLE control plane (no client writes) must not flip-flop:
+        the lease tick sends an empty apply even when the standby is
+        fully caught up, so a healthy-but-quiet primary is never
+        spuriously leapfrogged."""
+        a, b, eps, _ = _pair()                 # lease_timeout=0.3
+        try:
+            put_data_into_kvstore(eps, None, "sc", "k", b"v", timeout=10)
+            time.sleep(1.5)                    # >> every promotion grace
+            assert a.replication.status()["role"] == "primary"
+            st = b.replication.status()
+            assert st["role"] == "standby" and st["epoch"] == 1, st
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_rendezvous_addr_env_carries_comma_spec(self):
+        """The worker rendezvous path passes HOROVOD_GLOO_RENDEZVOUS_ADDR
+        + an int port straight into the client — an addr that carries the
+        comma spec must resolve to the set (port ignored), per
+        docs/elastic.md."""
+        a, b, _, reps = _pair()
+        try:
+            spec = ",".join(reps)
+            put_data_into_kvstore(spec, 12345, "rendezvous", "k", b"v",
+                                  timeout=10)
+            assert read_data_from_kvstore(spec, 12345, "rendezvous", "k",
+                                          timeout=10) == b"v"
+            assert b.snapshot()["rendezvous"]["k"] == b"v"
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_simultaneous_promotions_tie_break_by_index(self):
+        """Two standbys of a dead root promoting inside the same window
+        land on the SAME epoch — the replica-set index tie-break must
+        demote exactly one (the higher index), never leave a permanent
+        dual primary."""
+        ports = [find_free_port() for _ in range(3)]
+        reps = [f"127.0.0.1:{p}" for p in ports]
+        cfg = ReplicationConfig(lease_timeout=60, lease_interval=0.1)
+        servers = []
+        try:
+            for i, p in enumerate(ports):
+                s = KVStoreServer(("127.0.0.1", p))
+                s.start()
+                s.enable_replication(
+                    reps[i], reps, role="primary" if i == 0 else "standby",
+                    config=cfg)
+                servers.append(s)
+            eps = Endpoints([("127.0.0.1", p) for p in ports],
+                            reset_delay=0.1)
+            put_data_into_kvstore(eps, None, "sc", "pre", b"1", timeout=10)
+            servers[0].stop()                  # the root dies...
+            servers[1].replication.promote()   # ...and BOTH standbys
+            servers[2].replication.promote()   # promote to epoch 2
+            deadline = time.monotonic() + 8
+            while time.monotonic() < deadline:
+                roles = [s.replication.status()["role"]
+                         for s in servers[1:]]
+                if roles == ["primary", "standby"]:
+                    break
+                time.sleep(0.05)
+            assert [s.replication.status()["role"]
+                    for s in servers[1:]] == ["primary", "standby"]
+            # the surviving pair still serves acked writes
+            put_data_into_kvstore(eps, None, "sc", "post", b"2",
+                                  timeout=15)
+            assert servers[1].snapshot()["sc"]["post"] == b"2"
+        finally:
+            for s in servers[1:]:
+                s.stop()
+
+    def test_clear_scope_refusal_is_loud_on_standby(self, caplog):
+        import logging
+        a, b, eps, _ = _pair(cfg=ReplicationConfig(lease_timeout=60,
+                                                   lease_interval=0.1))
+        try:
+            put_data_into_kvstore(eps, None, "trace", "0", b"x", timeout=10)
+            with caplog.at_level(logging.WARNING,
+                                 logger="horovod_tpu.runner"):
+                b.clear_scope("trace")         # a standby cannot clear
+            assert any("clear_scope" in r.message for r in caplog.records)
+            assert b.snapshot().get("trace")   # nothing silently dropped
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_arm_from_kv_through_surviving_replica(self):
+        """Satellite: chaos scripts arm faults through a surviving
+        replica after a root kill — arm_from_kv takes the endpoint set
+        and reads from whichever replica answers."""
+        a, b, eps, _ = _pair(cfg=ReplicationConfig(**FAST))
+        try:
+            put_data_into_kvstore(eps, None, "faults", "spec",
+                                  b"test.cp_arm=2*noop()", timeout=10)
+            a.stop()                           # root gone; standby serves
+            assert faults.arm_from_kv(eps, timeout=10) is True
+            faults.failpoint("test.cp_arm")
+            assert faults.hits("test.cp_arm") == 1
+        finally:
+            faults.disarm()
+            b.stop()
+
+
+# ---------------------------------------------------------------------------
+# Chaos: a real SIGKILL of the primary, three critical windows + the
+# end-to-end elastic acceptance run.
+# ---------------------------------------------------------------------------
+
+_PRIMARY_SCRIPT = """
+import sys, time
+from horovod_tpu.runner.http_server import KVStoreServer
+from horovod_tpu.runner.replication import ReplicationConfig
+port, peer = int(sys.argv[1]), int(sys.argv[2])
+reps = [f"127.0.0.1:{port}", f"127.0.0.1:{peer}"]
+s = KVStoreServer(("127.0.0.1", port))
+s.enable_replication(reps[0], reps, role="primary",
+                     config=ReplicationConfig(lease_timeout=float(sys.argv[3]),
+                                              lease_interval=float(sys.argv[4])))
+s.start()
+print("READY", flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+class _KilledPrimary:
+    """A real subprocess primary + in-process standby, for SIGKILL chaos."""
+
+    def __init__(self, tmp_path, lease_timeout=0.3, lease_interval=0.1,
+                 primary_faults=None):
+        self.p1, self.p2 = find_free_port(), find_free_port()
+        self.reps = [f"127.0.0.1:{self.p1}", f"127.0.0.1:{self.p2}"]
+        # bind the standby's port now, but DON'T start its lease clock
+        # until the subprocess primary is actually serving — the primary
+        # pays a multi-second interpreter/jax import before READY, and a
+        # ticking lease would promote the standby before the primary's
+        # first heartbeat (an inverted scenario: the test must kill a
+        # live PRIMARY, not race a bootstrapping one)
+        self.standby = KVStoreServer(("127.0.0.1", self.p2))
+        self.standby.start()
+        script = tmp_path / "primary.py"
+        script.write_text(_PRIMARY_SCRIPT)
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO_ROOT + os.pathsep +
+                   os.environ.get("PYTHONPATH", ""))
+        env.pop("HOROVOD_TPU_FAULTS", None)
+        if primary_faults:
+            # armed in the SUBPROCESS only (e.g. a per-PUT delay that
+            # stretches an upload across the kill window); this process
+            # stays fault-free
+            env["HOROVOD_TPU_FAULTS"] = primary_faults
+        self.proc = subprocess.Popen(
+            [sys.executable, str(script), str(self.p1), str(self.p2),
+             str(lease_timeout), str(lease_interval)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            cwd=REPO_ROOT, env=env, text=True)
+        line = self.proc.stdout.readline()
+        assert "READY" in line, f"primary subprocess never came up: {line!r}"
+        self.standby.enable_replication(
+            self.reps[1], self.reps, role="standby",
+            config=ReplicationConfig(lease_timeout=lease_timeout,
+                                     lease_interval=lease_interval))
+        self.endpoints = Endpoints([("127.0.0.1", self.p1),
+                                    ("127.0.0.1", self.p2)],
+                                   trip_failures=3, reset_delay=0.1)
+
+    def sigkill_primary(self):
+        os.kill(self.proc.pid, signal.SIGKILL)
+        self.proc.wait(timeout=10)
+
+    def close(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+        self.standby.stop()
+
+    def assert_promoted_clean(self, timeout=10.0):
+        """The acked-write-loss proof shared by every kill test: the
+        standby promoted (waiting out the staggered lease grace), and its
+        journal replay shows contiguous sequences — nothing acked fell
+        into a gap."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and \
+                self.standby.replication.status()["role"] != "primary":
+            time.sleep(0.05)
+        st = self.standby.replication.status()
+        assert st["role"] == "primary", st
+        audit = self.standby.replication.audit_journal()
+        assert audit["gaps"] == [], audit
+
+
+@pytest.mark.chaos
+class TestPrimaryKillChaos:
+    def test_sigkill_mid_elastic_registration(self, tmp_path):
+        """(a) the elastic registration write set keeps landing across a
+        root SIGKILL: every ACKED registration survives on the promoted
+        standby (journal-audited), and the worker notification manager's
+        re-registration path works against the endpoint set afterwards."""
+        cp = _KilledPrimary(tmp_path)
+        reg = registry()
+        fo_before = reg.counter("hvd_tpu_kv_failover_total").total()
+        acked = {}
+        try:
+            for rank in range(8):
+                if rank == 3:
+                    cp.sigkill_primary()       # mid-sequence root kill
+                key, val = str(rank), f"host{rank}:90{rank}".encode()
+                put_data_into_kvstore(cp.endpoints, None,
+                                      "worker_addresses", key, val,
+                                      timeout=20)
+                acked[key] = val               # acked -> must survive
+            cp.assert_promoted_clean()
+            final = cp.standby.snapshot()["worker_addresses"]
+            for key, val in acked.items():
+                assert final[key] == val, f"acked registration {key} lost"
+            assert reg.counter("hvd_tpu_kv_failover_total").total() \
+                > fo_before
+            # the elastic manager's reregister path rides the same set
+            from horovod_tpu.elastic.worker import WorkerNotificationManager
+            mgr = WorkerNotificationManager()
+            mgr.init(rendezvous_addr=cp.endpoints, rendezvous_port=None,
+                     rank=0, hostname="hostA")
+            try:
+                mgr.reregister(rank=9)
+                assert "9" in cp.standby.snapshot()["worker_addresses"]
+            finally:
+                mgr.shutdown()
+        finally:
+            cp.close()
+
+    def test_sigkill_mid_checkpoint_shard_upload(self, tmp_path):
+        """(b) a chunked checkpoint-shard upload started against the
+        primary completes through the promoted standby, checksum-intact
+        (put_large_value writes the meta LAST, so the reader's sha256
+        proves every chunk survived the failover)."""
+        # every PUT on the primary pays 30ms, so the 10-chunk upload
+        # spans ~300ms and the kill lands mid-transfer deterministically
+        cp = _KilledPrimary(tmp_path,
+                            primary_faults="kv.server.put=*delay(30ms)")
+        value = os.urandom(300_000)            # 10 chunks of 32 KiB
+        box = {}
+
+        def _upload():
+            try:
+                put_large_value(cp.endpoints, None, "ckptshard", "g5.r0",
+                                value, chunk_bytes=32768, timeout=40)
+                box["done"] = True
+            except Exception as e:             # surfaced by the assert below
+                box["err"] = e
+
+        t = threading.Thread(target=_upload)
+        try:
+            t.start()
+            time.sleep(0.15)                   # a few chunks in flight
+            cp.sigkill_primary()
+            t.join(timeout=60)
+            assert box.get("done"), f"upload failed: {box.get('err')}"
+            got = read_large_value(cp.endpoints, None, "ckptshard",
+                                   "g5.r0", timeout=30)
+            assert got == value
+            cp.assert_promoted_clean()
+        finally:
+            cp.close()
+
+    def test_sigkill_mid_long_poll(self, tmp_path):
+        """(c) a long-poll GET in flight when the root dies keeps polling
+        across the failover and completes when the (post-promotion) write
+        lands — the reader never sees the kill."""
+        cp = _KilledPrimary(tmp_path)
+        got = {}
+
+        def _reader():
+            try:
+                got["v"] = read_data_from_kvstore(
+                    cp.endpoints, None, "rendezvous", "late", timeout=30,
+                    poll_interval=0.05)
+            except Exception as e:
+                got["err"] = e
+
+        t = threading.Thread(target=_reader)
+        try:
+            t.start()
+            time.sleep(0.2)                    # reader is mid-long-poll
+            cp.sigkill_primary()
+            put_data_into_kvstore(cp.endpoints, None, "rendezvous",
+                                  "late", b"after-failover", timeout=20)
+            t.join(timeout=30)
+            assert got.get("v") == b"after-failover", got
+            cp.assert_promoted_clean()
+        finally:
+            cp.close()
+
+    def test_elastic_run_survives_root_kill(self, tmp_path, monkeypatch):
+        """The acceptance proof: an elastic training run whose telemetry
+        rides a 1-primary/1-standby control plane (HOROVOD_KV_ENDPOINTS)
+        survives a SIGKILL of the primary mid-run — automatic promotion,
+        the run completes with NO restore/fleet restart, no acked-write
+        loss (journal audit), and the shed/failover counters are visible
+        in the standby's Prometheus scrape."""
+        import urllib.request
+        cp = _KilledPrimary(tmp_path)
+        monkeypatch.setenv("HOROVOD_KV_ENDPOINTS",
+                           ",".join(cp.reps))
+        monkeypatch.setenv("HOROVOD_TPU_METRICS_INTERVAL", "0.2")
+        reg = registry()
+        hvd.shutdown()
+        hvd.init()
+        restores = {"n": 0}
+
+        class _State(hvd.elastic.ObjectState):
+            def restore(self):
+                restores["n"] += 1
+                super().restore()
+
+        try:
+            state = _State(batch=0)
+            target = 6
+
+            @hvd.elastic.run
+            def train(state):
+                while state.batch < target:
+                    if state.batch == 2:
+                        cp.sigkill_primary()   # root dies mid-run
+                    out = np.asarray(hvd.allreduce(
+                        np.ones(2, np.float32),
+                        name=f"cp.b{state.batch}", op=hvd.Sum))
+                    assert out[0] == hvd.size()
+                    state.batch += 1
+                    state.commit()
+                    time.sleep(0.05)
+                return state.batch
+
+            assert train(state) == target
+            assert restores["n"] == 0, "control-plane death restarted " \
+                                       "the fleet"
+            cp.assert_promoted_clean()
+            # a deterministic post-failover publish (its own sweep fails
+            # over past the dead primary), then the scrape from the
+            # SURVIVING replica must carry the failover counters
+            publish_snapshot((cp.endpoints, None), hvd.rank(),
+                             reg.snapshot())
+            assert reg.counter("hvd_tpu_kv_failover_total").total() > 0
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{cp.p2}/metrics/",
+                    timeout=10) as resp:
+                scrape = resp.read().decode()
+            assert "hvd_tpu_kv_failover_total" in scrape
+            assert "hvd_tpu_kv_promotions_total" in scrape
+            # chaos scripts can still arm faults through the survivor
+            put_data_into_kvstore(cp.endpoints, None, "faults", "spec",
+                                  b"test.cp_post=1*noop()", timeout=10)
+            assert faults.arm_from_kv(cp.endpoints, timeout=10) is True
+        finally:
+            faults.disarm()
+            hvd.shutdown()
+            cp.close()

@@ -227,8 +227,7 @@ class TestNotificationRoundtrip:
 
             mgr.register_listener(Listener())
             # the driver reads the advertised address from the KV store
-            with rdv._lock:
-                addr = rdv._store["worker_addresses"]["0"].decode()
+            addr = rdv.snapshot()["worker_addresses"]["0"].decode()
             WorkerNotificationClient(addr).notify_hosts_updated(
                 42, HostUpdateResult.REMOVED)
             deadline = time.monotonic() + 5

@@ -71,6 +71,11 @@ SECTIONS = [
         "checksum", "reshard_ranges", "zero1_reshard"]),
     ("Cluster run API", "horovod_tpu.runner", [
         "run", "run_elastic"]),
+    ("Replicated control plane", "horovod_tpu.runner.replication", [
+        "ReplicaCoordinator", "ReplicationConfig"]),
+    ("", "horovod_tpu.runner.http_client", [
+        "Endpoints", "resolve_endpoints", "parse_endpoint_spec",
+        "KVBackpressure"]),
     ("Estimator & store", "horovod_tpu", []),
     ("Models", "horovod_tpu.models.transformer", [
         "TransformerConfig", "init_params", "forward_block", "lean_lm_loss",
