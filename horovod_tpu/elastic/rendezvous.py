@@ -13,7 +13,7 @@ import logging
 from typing import Dict
 
 from ..faults import DROP, failpoint
-from ..runner.http_server import RendezvousServer
+from ..runner.http_server import OK, RendezvousServer, _normalize
 
 _LOG = logging.getLogger("horovod_tpu.elastic")
 
@@ -38,9 +38,35 @@ class ElasticRendezvousServer(RendezvousServer):
         self._driver = driver
 
     def init(self, host_assignments, coordinator_addr=None):
+        slots = {f"{s.hostname}:{s.local_rank}": s
+                 for s in host_assignments}
+        if self._repl is not None:
+            # Replicated set: standbys serve every read, so the new-world
+            # clears must ride the journaled write path or a worker GET
+            # against a standby could fetch the PREVIOUS world's
+            # coordinator/addrs. client_write nests coordinator->server
+            # locks, so it runs OUTSIDE self._lock; the clears land (and
+            # replicate, quorum-acked) BEFORE the plan swap below, so on
+            # every replica the clears reached, a GET that sees the new
+            # plan sees a cleared (or re-seeded) coordinator. clear_scope
+            # warns loudly when the replication tier refuses (e.g. this
+            # server is itself a standby).
+            self.clear_scope(self.SCOPE_COORD)
+            self.clear_scope(self.SCOPE_WORKER_ADDRS)
+            if coordinator_addr is not None:
+                code = _normalize(self._repl.client_write(
+                    "put", self.SCOPE_COORD, "addr",
+                    coordinator_addr.encode()))[0]
+                if code != OK:
+                    _LOG.warning(
+                        "replicated coordinator seed refused (HTTP %d): "
+                        "workers will long-poll until rank 0 republishes "
+                        "the address", code)
+            with self._lock:
+                self._slots_by_key = slots
+            return self.port
         with self._lock:
-            self._slots_by_key = {
-                f"{s.hostname}:{s.local_rank}": s for s in host_assignments}
+            self._slots_by_key = slots
             # New world ⇒ new JAX coordinator; drop the stale address so
             # non-zero ranks block until the new rank 0 republishes it
             # (ordering guaranteed by this lock: any GET that sees the new

@@ -189,6 +189,13 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
     "hvd_tpu_kv_fenced_writes_total": (
         "counter", "Stale-epoch replication messages rejected by the "
                    "fence (zombie ex-primary streams)"),
+    "hvd_tpu_kv_acked_writes_lost_total": (
+        "counter", "Acked KV writes potentially lost across a failover: "
+                   "acks granted under a degraded (SUSPECT-excused) "
+                   "quorum discarded when their primary was fenced, plus "
+                   "divergent-tail entries truncated off an ahead peer "
+                   "by snapshot resync — the degraded-durability window "
+                   "made countable, never asserted away"),
     # faults.py
     "hvd_tpu_fault_injections_total": (
         "counter", "Fired fault-injection actions, by failpoint name and "

@@ -342,6 +342,15 @@ class KVStoreServer(ThreadingHTTPServer):
                 return json.dumps(self._repl.status()).encode()
             if key == "journal":
                 return json.dumps(self._repl.audit_journal()).encode()
+            if key.startswith("tail/"):
+                # journal tail past a seq — a promoting peer's election-
+                # restriction catch-up source (replication.py)
+                try:
+                    from_seq = int(key.split("/", 1)[1])
+                except ValueError:
+                    return None
+                return json.dumps(
+                    self._repl.journal_tail(from_seq)).encode()
             return None
         with self._lock:
             return self._store.get(scope, {}).get(key)

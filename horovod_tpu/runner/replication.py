@@ -20,11 +20,23 @@ Promotion and fencing
 The primary's replication stream doubles as its **lease**: every tick (and
 every write) refreshes the standbys' ``last_lease``. A standby whose lease
 has been silent past ``HOROVOD_KV_LEASE_TIMEOUT * (1 + index)`` (index =
-its position in the replica set — deterministic stagger, no election
-protocol) promotes itself: it **replays/audits the journal** (per-scope
-``sseq`` and global ``seq`` contiguity — gaps are *detected and counted*,
-never silently skipped), bumps the **epoch**, and starts streaming to the
-remaining replicas. Every replication message carries the sender's epoch;
+its position in the replica set — deterministic stagger, no leader
+election) promotes itself, subject to the **election restriction**: it
+first polls surviving peers' ``/_repl/status`` — a reachable live primary
+at a current epoch refreshes the lease instead (stream hiccup, not a
+death), and a peer that has *applied further* holds writes (possibly
+quorum-acked on {dead primary, that peer}) the stagger order alone would
+lose, so the candidate pulls that peer's journal tail
+(``/_repl/tail/<seq>``) and applies it before promoting (deferring a
+bounded number of rounds when it cannot). Promotion then
+**replays/audits the journal** (per-scope ``sseq`` and global ``seq``
+contiguity — gaps are *detected and counted*, never silently skipped),
+bumps the **epoch**, and starts streaming to the remaining replicas. A
+new primary that finds a peer's applied seq AHEAD of its own journal head
+treats it as divergence — the peer is snapshot-resynced (its tail
+truncated, loudly) before it may count toward any ack quorum, never
+silently treated as synced. Every replication message carries the
+sender's epoch;
 a receiver fences anything stale (``412``), so a zombie ex-primary's late
 stream is rejected — and on seeing the fence (or any message with a newer
 epoch) the zombie **demotes itself to standby** and resyncs from the new
@@ -166,7 +178,22 @@ class ReplicaCoordinator:
         "last_lease": "_lock",
         "primary_hint": "_lock",
         "gap_log": "_lock",
+        "full_quorum_seq": "_lock",
+        "degraded_ack_seqs": "_lock",
+        "degraded_ack_untracked": "_lock",
+        "_election_defers": "_lock",
     }
+
+    # per-seq degraded-ack tracking is bounded: past the cap only a count
+    # is kept (cleared once full-majority coverage reaches the journal
+    # head), so a standby dead for hours under a chatty telemetry load
+    # cannot grow an unbounded list on the primary
+    DEGRADED_TRACK_MAX = 4096
+
+    # promotion rounds a standby defers to a more-applied peer it cannot
+    # catch up from before promoting anyway (availability wins, loudly) —
+    # bounds the reachable-but-wedged-peer case
+    ELECTION_DEFER_MAX = 3
 
     def __init__(self, server, self_addr: str, replicas: List[str],
                  role: str = STANDBY,
@@ -195,6 +222,15 @@ class ReplicaCoordinator:
         self.primary_hint: Optional[str] = (
             self_addr if role == PRIMARY else None)
         self.gap_log: List[str] = []
+        # durability bookkeeping behind the demotion-loss report: the
+        # highest seq known applied on a FULL-set majority (no SUSPECT
+        # excusal), and the seqs of writes acked below it — those are the
+        # writes a fence can lose DESPITE the ack, and they are counted
+        # (hvd_tpu_kv_acked_writes_lost_total), never waved away
+        self.full_quorum_seq = 0
+        self.degraded_ack_seqs: List[int] = []
+        self.degraded_ack_untracked = 0
+        self._election_defers = 0
         self.peers = [_Peer(r) for r in self.replicas
                       if r != self.self_addr]
         n = len(self.replicas)
@@ -262,6 +298,28 @@ class ReplicaCoordinator:
         return {"base": base, "entries": len(entries), "last": prev,
                 "scopes": per_scope, "gaps": gaps}
 
+    def journal_tail(self, from_seq: int) -> dict:
+        """Retained journal entries with seq > ``from_seq`` (b64 values),
+        served over ``GET /_repl/tail/<seq>`` for a promoting peer's
+        pre-promotion catch-up (the election restriction). ``entries`` is
+        None when ``from_seq`` predates the retained window — the caller
+        cannot be made contiguous from here (snapshot territory, and only
+        a primary pushes snapshots)."""
+        with self._lock:
+            base = self.journal_base
+            applied = self.applied_seq
+            epoch = self.epoch
+            entries = (None if from_seq < base else
+                       [e for e in self.journal if e["seq"] > from_seq])
+        if entries is not None:
+            # b64 of up to journal_max_bytes happens OFF the lock (the
+            # audit_journal copy-then-process pattern): entry dicts are
+            # never mutated after append, so the shallow copies stay
+            # valid across a concurrent trim
+            entries = [{**e, "value": _b64e(e["value"])} for e in entries]
+        return {"epoch": epoch, "base": base, "applied": applied,
+                "entries": entries}
+
     # requires: _lock
     def _append_journal_locked(self, entry: dict):
         self.journal.append(entry)
@@ -324,9 +382,52 @@ class ReplicaCoordinator:
             return (UNAVAILABLE, {"Retry-After": "0.2"},
                     json.dumps({"error": "no_quorum", "acks": acks,
                                 "need": self.ack_quorum}).encode())
+        self._note_ack_durability(target, acks)
         if op == "delete" and not existed:
             return 404
         return OK
+
+    def _note_ack_durability(self, target_seq: int, acks: int):
+        """Record whether this ack reached a FULL-set majority or only a
+        degraded (SUSPECT-excused) quorum. Degraded acks are the writes a
+        later fence can lose despite the ack — they stay in
+        ``degraded_ack_seqs`` until background catch-up or a later
+        full-majority ack covers them (replication is contiguous per
+        peer, so full-majority coverage at seq T covers every seq <= T),
+        and are counted loudly on demotion."""
+        full_majority = len(self.replicas) // 2 + 1
+        with self._lock:
+            self._update_full_quorum_locked()
+            if acks < full_majority and target_seq > self.full_quorum_seq:
+                if len(self.degraded_ack_seqs) < self.DEGRADED_TRACK_MAX:
+                    self.degraded_ack_seqs.append(target_seq)
+                else:
+                    self.degraded_ack_untracked += 1
+
+    # requires: _lock
+    def _update_full_quorum_locked(self):
+        """Recompute the highest seq covered by a full-set majority from
+        current peer acks (self counts as one replica) and prune the
+        degraded-ack list it newly covers. Called wherever a peer's acked
+        seq advances, so background catch-up — not just client-write
+        acks — shrinks the at-risk window."""
+        need_peers = len(self.replicas) // 2      # majority minus self
+        if need_peers <= 0:
+            covered = self.seq
+        else:
+            acks = sorted((p.acked for p in self.peers
+                           if p.acked is not None), reverse=True)
+            if len(acks) < need_peers:
+                return
+            covered = min(self.seq, acks[need_peers - 1])
+        if covered > self.full_quorum_seq:
+            self.full_quorum_seq = covered
+            self.degraded_ack_seqs = [s for s in self.degraded_ack_seqs
+                                      if s > covered]
+            if covered >= self.seq:
+                # coverage reached the journal head: every degraded ack,
+                # tracked or counted past the cap, is durable now
+                self.degraded_ack_untracked = 0
 
     def _effective_quorum(self) -> int:
         """The ack quorum actually required right now. An explicitly
@@ -374,9 +475,14 @@ class ReplicaCoordinator:
             try:
                 if self._sync_peer(peer, target_seq, deadline):
                     acks += 1
-                    self._record_peer_outcome(peer, True)
-                else:
-                    self._record_peer_outcome(peer, False)
+                # reached on True AND False: transport failures raise, so
+                # a False return means the peer ANSWERED but has not yet
+                # applied target_seq (e.g. mid-snapshot after a shard
+                # burst) — it withholds its ack but is alive, and must
+                # not accrue a SUSPECT streak: excusing a lagging-but-
+                # live replica from the majority denominator would
+                # silently shrink the quorum
+                self._record_peer_outcome(peer, True)
             except _Fenced as f:
                 self._observe_epoch(f.epoch, f.primary)
                 break
@@ -406,6 +512,12 @@ class ReplicaCoordinator:
                 info = json.loads(e.read() or b"{}")
                 raise _ApplyGap(int(info.get("applied", -1)))
             raise
+
+    def _get_json(self, peer: _Peer, path: str, timeout: float) -> dict:
+        with urllib.request.urlopen(
+                f"http://{peer.host}:{peer.port}/{REPL_SCOPE}/{path}",
+                timeout=timeout) as resp:
+            return json.loads(resp.read() or b"{}")
 
     def _sync_peer(self, peer: _Peer, target_seq: int,
                    deadline: Optional[float] = None,
@@ -438,6 +550,8 @@ class ReplicaCoordinator:
                 acked = int(resp.get("applied", -1))
                 with self._lock:
                     peer.acked = acked
+                    self._update_full_quorum_locked()
+            acked = self._resync_if_ahead(peer, acked, timeout)
             if acked >= target_seq:
                 return True
             with self._lock:
@@ -467,11 +581,48 @@ class ReplicaCoordinator:
             applied = int(resp.get("applied", -1))
             with self._lock:
                 peer.acked = applied
+                self._update_full_quorum_locked()
+            applied = self._resync_if_ahead(peer, applied, timeout)
             if entries:
                 from ..metrics import registry as metrics_registry
                 metrics_registry().counter(
                     "hvd_tpu_kv_repl_entries_total").inc(len(entries))
             return applied >= target_seq
+
+    def _resync_if_ahead(self, peer: _Peer, acked: int,
+                         timeout: float) -> int:
+        """Divergence fence for a peer reporting an applied seq AHEAD of
+        our own journal head: the dead primary replicated further to that
+        peer than to us before the promotion, or its tail was written
+        under an older epoch — its overlapping seqs hold writes ours do
+        not. Treating it as synced would manufacture a false quorum ack
+        while our writes at those seqs are never sent to it: silent,
+        permanent store divergence on a read-serving standby. Instead the
+        peer is snapshot-resynced (its tail truncated to our state,
+        counted as potentially-lost acked writes) BEFORE it may count
+        toward any ack. Returns the peer's refreshed applied seq; caller
+        holds ``peer.send_lock``."""
+        with self._lock:
+            my_seq = self.seq
+        if acked <= my_seq:
+            return acked
+        logger.error(
+            "KV peer %s applied seq %d is AHEAD of primary %s (seq %d): "
+            "divergent tail from a previous reign — %d entry(ies), "
+            "possibly acked there, are truncated by snapshot resync "
+            "(hvd_tpu_kv_acked_writes_lost_total); the peer cannot count "
+            "toward an ack quorum until it matches this primary's log",
+            peer.addr, acked, self.self_addr, my_seq, acked - my_seq)
+        self._push_snapshot(peer, timeout)
+        # counted only once the truncation actually happened: a failed
+        # push raises above, the peer stays ahead, and the next round
+        # re-detects — incrementing first would multi-count one
+        # divergence across retries
+        from ..metrics import registry as metrics_registry
+        metrics_registry().counter(
+            "hvd_tpu_kv_acked_writes_lost_total").inc(acked - my_seq)
+        with self._lock:
+            return peer.acked if peer.acked is not None else -1
 
     def _push_snapshot(self, peer: _Peer, timeout: float):
         """Full-state resync: ships the whole store + seq counters. Used
@@ -497,6 +648,7 @@ class ReplicaCoordinator:
                           max(timeout, 1.0))
         with self._lock:
             peer.acked = int(resp.get("applied", -1))
+            self._update_full_quorum_locked()
 
     # -- standby: apply / promote -------------------------------------------
 
@@ -635,6 +787,8 @@ class ReplicaCoordinator:
         been fenced and demotes itself (resync via snapshot on the new
         primary's next contact)."""
         demoted = False
+        at_risk: List[int] = []
+        untracked = 0
         with self._lock:
             if epoch < self.epoch:
                 return
@@ -652,21 +806,151 @@ class ReplicaCoordinator:
             if self.role == PRIMARY:
                 demoted = True
                 self.role = STANDBY
-                # local journal may hold unreplicated (hence unacked)
-                # writes the new primary never saw: mark diverged so the
-                # next contact resyncs the whole store
+                # local journal may hold unreplicated writes the new
+                # primary never saw: mark diverged so the next contact
+                # resyncs the whole store. Writes acked while SUSPECT
+                # peers were excused (degraded quorum) never reached a
+                # full-set majority — those are real acked writes the
+                # discard CAN lose, and they are reported below, never
+                # asserted away
                 self.applied_seq = -1
+                at_risk = list(self.degraded_ack_seqs)
+                untracked = self.degraded_ack_untracked
+                self.degraded_ack_seqs = []
+                self.degraded_ack_untracked = 0
             self.epoch = epoch
             self.last_lease = time.monotonic()
             if primary:
                 self.primary_hint = primary
-        if demoted:
+        if demoted and (at_risk or untracked):
+            total = len(at_risk) + untracked
+            from ..metrics import registry as metrics_registry
+            metrics_registry().counter(
+                "hvd_tpu_kv_acked_writes_lost_total").inc(total)
+            logger.error(
+                "KV replica %s: fenced at epoch %d (new primary %s) — "
+                "demoted to standby, store marked for resync. %d write(s) "
+                "(seq %d..%d%s) were ACKED under a DEGRADED quorum and "
+                "never reached a full-set majority: they are LOST unless "
+                "the new primary holds them "
+                "(hvd_tpu_kv_acked_writes_lost_total); never-acked local "
+                "writes are discarded as always", self.self_addr, epoch,
+                primary, total,
+                min(at_risk) if at_risk else 0,
+                max(at_risk) if at_risk else 0,
+                f" +{untracked} past the tracking cap" if untracked else "")
+        elif demoted:
             logger.warning(
                 "KV replica %s: fenced at epoch %d (new primary %s) — "
                 "demoted to standby, store marked for resync; locally "
-                "journaled unacked writes are discarded (they never "
-                "reached quorum, so no client saw them acked)",
-                self.self_addr, epoch, primary)
+                "journaled unacked writes are discarded (every ack this "
+                "primary granted had reached a full-set majority, so no "
+                "acked write is lost)", self.self_addr, epoch, primary)
+
+    def _election_clearance(self) -> bool:
+        """Raft-style election restriction gating the *automatic* (lease-
+        expiry) promotion: the index stagger alone orders candidates by
+        position, not log completeness, so a write acked on {dead
+        primary, standby-2} would be lost if less-complete standby-1
+        promoted first. Poll surviving peers' status: a reachable live
+        primary at a current epoch refreshes our lease (its stream
+        hiccuped; it is not dead); a peer that has APPLIED further than
+        us lends us its journal tail, applied through the standard path,
+        before we promote. When the tail cannot be fetched or applied,
+        defer this round — the more-complete peer's own staggered grace
+        elects it — but only ``ELECTION_DEFER_MAX`` times, then promote
+        anyway (loudly): a reachable-but-wedged peer must not hold the
+        control plane down forever."""
+        with self._lock:
+            my_epoch = self.epoch
+            my_applied = self.applied_seq
+        timeout = max(self.config.lease_interval, 0.25)
+        best: Optional[Tuple[_Peer, int]] = None
+        for peer in self.peers:
+            try:
+                st = self._get_json(peer, "status", timeout)
+            except Exception:
+                continue                       # dead peer: no vote to take
+            if st.get("role") == PRIMARY and \
+                    int(st.get("epoch", 0)) >= my_epoch:
+                with self._lock:
+                    self.last_lease = time.monotonic()
+                    self.primary_hint = st.get("self") or self.primary_hint
+                    self._election_defers = 0
+                logger.info(
+                    "KV standby %s: lease silent but primary %s is live "
+                    "(epoch %d) — not promoting", self.self_addr,
+                    st.get("self"), int(st.get("epoch", 0)))
+                return False
+            peer_applied = int(st.get("applied_seq", -1))
+            if peer_applied > my_applied and (
+                    best is None or peer_applied > best[1]):
+                best = (peer, peer_applied)
+        if best is None or self._catch_up_from(best[0], my_applied):
+            with self._lock:
+                self._election_defers = 0
+            return True
+        peer, peer_applied = best
+        with self._lock:
+            self._election_defers += 1
+            defers = self._election_defers
+        if defers >= self.ELECTION_DEFER_MAX:
+            with self._lock:
+                self._election_defers = 0
+            logger.error(
+                "KV standby %s promoting WITHOUT the journal tail of "
+                "more-applied peer %s (applied %d > ours %d) after %d "
+                "deferred rounds — writes acked past seq %d may be lost; "
+                "availability wins, loudly", self.self_addr, peer.addr,
+                peer_applied, my_applied, defers, my_applied)
+            return True
+        logger.warning(
+            "KV standby %s deferring promotion (round %d/%d): peer %s "
+            "has applied seq %d > ours %d and its tail could not be "
+            "fetched/applied — letting the more-complete replica promote "
+            "first", self.self_addr, defers, self.ELECTION_DEFER_MAX,
+            peer.addr, peer_applied, my_applied)
+        return False
+
+    def _catch_up_from(self, peer: _Peer, my_applied: int) -> bool:
+        """Pull ``peer``'s journal tail past ``my_applied`` and apply it
+        through the standard apply path (contiguity checks, journaling,
+        store order all preserved). True when our applied seq reached the
+        peer's reported applied seq."""
+        if my_applied < 0:
+            return False       # diverged store: only a snapshot reseeds us
+        timeout = max(self.config.lease_interval, 0.25)
+        try:
+            tail = self._get_json(peer, f"tail/{my_applied}", timeout)
+        except Exception as e:
+            logger.debug("journal tail fetch from %s failed: %s",
+                         peer.addr, e)
+            return False
+        entries = tail.get("entries")
+        if entries is None:
+            return False                       # trimmed past our seq
+        with self._lock:
+            my_epoch = self.epoch
+            lease_before = self.last_lease
+        self._handle_apply({"epoch": my_epoch, "base": my_applied,
+                            "entries": entries})
+        with self._lock:
+            now = self.applied_seq
+            if now < int(tail.get("applied", -1)):
+                # failed/partial catch-up: undo the self-apply's lease
+                # refresh (a real primary reappearing re-refreshes on its
+                # next contact) so the next defer round retries after one
+                # loop interval, not a fresh full lease grace
+                self.last_lease = lease_before
+        if now < int(tail.get("applied", -1)):
+            return False
+        if now > my_applied:
+            logger.warning(
+                "KV standby %s caught up the journal tail from %s before "
+                "promoting (applied %d -> %d): stagger order would have "
+                "lost those writes", self.self_addr, peer.addr,
+                my_applied, now)
+        return True
 
     def promote(self, reason: str = "manual"):
         """Standby -> primary: replay/audit the journal, bump the epoch,
@@ -719,8 +1003,10 @@ class ReplicaCoordinator:
             if role == PRIMARY:
                 for peer in self.peers:
                     try:
-                        ok = self._sync_peer(peer, target, heartbeat=True)
-                        self._record_peer_outcome(peer, ok)
+                        self._sync_peer(peer, target, heartbeat=True)
+                        # answered == alive, even if still catching up
+                        # (transport failures raise; see _replicate)
+                        self._record_peer_outcome(peer, True)
                     except _Fenced as f:
                         self._observe_epoch(f.epoch, f.primary)
                         break
@@ -732,8 +1018,13 @@ class ReplicaCoordinator:
                 grace = self.config.lease_timeout * (1 + self.standby_index)
                 if lease_age > grace:
                     try:
-                        self.promote(reason=f"lease silent {lease_age:.2f}s "
-                                            f"(> {grace:.2f}s)")
+                        # election restriction first: defer to a live
+                        # primary or pull the tail of a more-applied peer
+                        # so stagger order never out-runs log completeness
+                        if self._election_clearance():
+                            self.promote(
+                                reason=f"lease silent {lease_age:.2f}s "
+                                       f"(> {grace:.2f}s)")
                     except Exception as e:
                         logger.error("automatic promotion failed: %s", e)
             if self._stop_evt.wait(interval):

@@ -72,6 +72,24 @@ def _pair(role_b="standby", cfg=None, cfg_b=None):
     return a, b, eps, reps
 
 
+def _repl_put(port, key, payload, timeout=5):
+    """Raw replication-control PUT — lets a test play the role of a
+    (possibly dead or zombie) primary on the wire."""
+    import urllib.request
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/_repl/{key}",
+        data=json.dumps(payload).encode(), method="PUT")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def _entry(seq, sseq, scope, key, value, epoch=1):
+    import base64
+    return {"seq": seq, "sseq": sseq, "epoch": epoch, "scope": scope,
+            "op": "put", "key": key,
+            "value": base64.b64encode(value).decode()}
+
+
 # ---------------------------------------------------------------------------
 # Endpoint set + circuit breaker (client tier)
 # ---------------------------------------------------------------------------
@@ -551,6 +569,204 @@ class TestReplication:
             assert faults.hits("test.cp_arm") == 1
         finally:
             faults.disarm()
+            b.stop()
+
+
+# ---------------------------------------------------------------------------
+# Failover-correctness regressions (review findings): ahead-peer
+# divergence, election restriction, degraded-ack accounting, lagging-peer
+# streaks, replicated elastic-init clears.
+# ---------------------------------------------------------------------------
+
+class TestFailoverCorrectness:
+    def test_ahead_peer_truncated_never_counted_as_synced(self):
+        """A peer whose applied seq runs AHEAD of the primary (a dead
+        root replicated further to it before the failover) must be
+        snapshot-resynced — tail truncated, loudly — never treated as
+        fully synced: counting it would fake quorum acks while its
+        read-serving store silently diverges forever."""
+        reg = registry()
+        lost_before = reg.counter(
+            "hvd_tpu_kv_acked_writes_lost_total").total()
+        a, b, eps, reps = _pair()              # FAST heartbeats
+        try:
+            put_data_into_kvstore(eps, None, "reg", "k1", b"v1",
+                                  timeout=10)
+            assert b.replication.status()["applied_seq"] == 1
+            # inject a divergent tail on B, as if a prior reign had
+            # replicated seqs 2..3 to B but never to A
+            _repl_put(b.port, "apply", {
+                "epoch": 1, "base": 1, "primary": reps[0],
+                "entries": [_entry(2, 1, "ghost", "g1", b"x"),
+                            _entry(3, 2, "ghost", "g2", b"y")]})
+            assert b.replication.status()["applied_seq"] == 3
+            # A's next heartbeat sees B ahead and truncates it back (the
+            # loss counter lands only after A reads the push response —
+            # a beat after B's store resets — so poll for both)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and (
+                    b.replication.status()["applied_seq"] != 1 or
+                    reg.counter("hvd_tpu_kv_acked_writes_lost_total")
+                    .total() < lost_before + 2):
+                time.sleep(0.05)
+            assert b.replication.status()["applied_seq"] == 1
+            assert "ghost" not in b.snapshot()
+            assert reg.counter(
+                "hvd_tpu_kv_acked_writes_lost_total").total() \
+                >= lost_before + 2
+            # and the pair converges on new acked writes
+            put_data_into_kvstore(eps, None, "reg", "k2", b"v2",
+                                  timeout=10)
+            assert b.snapshot()["reg"]["k2"] == b"v2"
+            assert b.replication.status()["applied_seq"] == \
+                a.replication.status()["seq"]
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_election_restriction_pulls_tail_from_more_applied_peer(self):
+        """A write replicated to standby-2 but not standby-1 when the
+        root dies must survive standby-1's (earlier-staggered) automatic
+        promotion: the candidate pulls the journal tail from the
+        more-applied peer BEFORE promoting, instead of winning on index
+        order and losing a quorum-acked write."""
+        dead = f"127.0.0.1:{find_free_port()}"   # the SIGKILLed root
+        pb, pc = find_free_port(), find_free_port()
+        b = KVStoreServer(("127.0.0.1", pb))
+        c = KVStoreServer(("127.0.0.1", pc))
+        b.start()
+        c.start()
+        reps = [dead, f"127.0.0.1:{pb}", f"127.0.0.1:{pc}"]
+        try:
+            # C (index 2) saw seqs 1..3 from the dead root; B (index 1,
+            # promotes first) only 1..2 — seq 3 was quorum-acked on
+            # {root, C} and must not be lost
+            c.enable_replication(
+                reps[2], reps, role="standby",
+                config=ReplicationConfig(lease_timeout=60,
+                                         lease_interval=0.1))
+            entries = [_entry(i, i, "reg", f"k{i}", f"v{i}".encode())
+                       for i in (1, 2, 3)]
+            _repl_put(pc, "apply", {"epoch": 1, "base": 0,
+                                    "primary": dead, "entries": entries})
+            b.enable_replication(reps[1], reps, role="standby",
+                                 config=ReplicationConfig(**FAST))
+            _repl_put(pb, "apply", {"epoch": 1, "base": 0,
+                                    "primary": dead,
+                                    "entries": entries[:2]})
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and \
+                    b.replication.status()["role"] != "primary":
+                time.sleep(0.05)
+            st = b.replication.status()
+            assert st["role"] == "primary" and st["epoch"] >= 2
+            assert st["applied_seq"] == 3      # caught up BEFORE promoting
+            assert b.snapshot()["reg"]["k3"] == b"v3"
+            assert b.replication.audit_journal()["gaps"] == []
+        finally:
+            b.stop()
+            c.stop()
+
+    def test_degraded_acks_counted_lost_on_demotion(self, caplog):
+        """Acks granted while the quorum was degraded (peer SUSPECT) are
+        NOT 'never reached quorum': on fencing they are counted
+        (hvd_tpu_kv_acked_writes_lost_total) and logged at ERROR —
+        reported, never asserted away."""
+        import logging
+        reg = registry()
+        lost_before = reg.counter(
+            "hvd_tpu_kv_acked_writes_lost_total").total()
+        cfg = ReplicationConfig(lease_timeout=60, lease_interval=30)
+        a, b, eps, reps = _pair(cfg=cfg)
+        try:
+            put_data_into_kvstore(eps, None, "sc", "pre", b"1", timeout=10)
+            faults.arm("kv.replicate=*raise(ConnectionError)")
+            put_data_into_kvstore(eps, None, "sc", "deg", b"2", timeout=30)
+            assert a.replication.degraded_ack_seqs
+            with caplog.at_level(logging.ERROR,
+                                 logger="horovod_tpu.runner"):
+                # B's post-promotion stream, on the wire: fences A
+                _repl_put(a.port, "apply", {"epoch": 2, "base": None,
+                                            "primary": reps[1],
+                                            "entries": []})
+            st = a.replication.status()
+            assert st["role"] == "standby" and st["epoch"] == 2
+            assert reg.counter(
+                "hvd_tpu_kv_acked_writes_lost_total").total() > lost_before
+            assert any("DEGRADED quorum" in r.message
+                       for r in caplog.records)
+        finally:
+            faults.disarm()
+            a.stop()
+            b.stop()
+
+    def test_lagging_answering_peer_keeps_full_quorum(self):
+        """Only transport-level failures accrue the SUSPECT streak: a
+        peer that ANSWERS but has not caught up (e.g. mid-snapshot after
+        a shard burst) withholds its ack yet stays in the quorum
+        denominator — durability must not silently shrink because a
+        replica is slow."""
+        cfg = ReplicationConfig(lease_timeout=60, lease_interval=30)
+        a, b, eps, _ = _pair(cfg=cfg)
+        try:
+            put_data_into_kvstore(eps, None, "sc", "k", b"v", timeout=10)
+            coord = a.replication
+            peer = coord.peers[0]
+            with coord._lock:
+                peer.fail_streak = 0           # clear startup noise
+                peer.suspect = False
+            orig = coord._sync_peer
+            coord._sync_peer = lambda *args, **kw: False   # answers, lags
+            try:
+                for _ in range(5):
+                    assert coord._replicate(coord.status()["seq"]) == 0
+                assert not peer.suspect and peer.fail_streak == 0
+                def _boom(*args, **kw):
+                    raise ConnectionError("link down")
+                coord._sync_peer = _boom       # transport failures count
+                for _ in range(3):
+                    coord._replicate(coord.status()["seq"])
+                assert peer.suspect
+            finally:
+                coord._sync_peer = orig
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_elastic_init_clears_replicate_to_standby(self):
+        """New world ⇒ cleared coordinator — on EVERY replica. The
+        init-time clears ride the journaled write path, so a worker GET
+        against a read-serving standby can never fetch the previous
+        world's coordinator address."""
+        from horovod_tpu.elastic.rendezvous import ElasticRendezvousServer
+        p1, p2 = find_free_port(), find_free_port()
+        a = ElasticRendezvousServer(("127.0.0.1", p1))
+        b = KVStoreServer(("127.0.0.1", p2))
+        a.start()
+        b.start()
+        reps = [f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"]
+        a.enable_replication(reps[0], reps, role="primary",
+                             config=ReplicationConfig(**FAST))
+        b.enable_replication(reps[1], reps, role="standby",
+                             config=ReplicationConfig(**FAST))
+        eps = Endpoints([("127.0.0.1", p1), ("127.0.0.1", p2)],
+                        reset_delay=0.1)
+        try:
+            put_data_into_kvstore(eps, None, "coordinator", "addr",
+                                  b"old:1", timeout=10)
+            put_data_into_kvstore(eps, None, "worker_addresses", "0",
+                                  b"w0:1", timeout=10)
+            assert b.snapshot()["coordinator"]["addr"] == b"old:1"
+            a.init([])                         # new world, no seed yet
+            # client_write acks only after the standby applied: the
+            # standby's view is already clean, no wait loop needed
+            assert not b.snapshot().get("coordinator")
+            assert not b.snapshot().get("worker_addresses")
+            a.init([], coordinator_addr="new:2")
+            assert b.snapshot().get("coordinator", {}).get("addr") \
+                == b"new:2"
+        finally:
+            a.stop()
             b.stop()
 
 
