@@ -232,6 +232,39 @@ def _measure_lm(cfg, B):
     return dt, n_params, model_flops, spread, n_used
 
 
+def _hier_wire_projection(leaves, threshold, codec="int8", size=8,
+                          local=4):
+    """Link-labeled per-step wire bytes of one gradient set on a
+    reference (size, local) hierarchical fabric, codec "none" vs
+    ``codec`` — the engine's bucket/selection/link_split rules applied to
+    the model's real bucket layout (ISSUE 13). The dev rig's one-process
+    world moves zero DCN bytes, so the model sections emit this
+    projection next to the measured registry deltas to make the
+    before/after visible in every BENCH round. Returns
+    ``{"none": {link: bytes}, codec: {link: bytes}}``."""
+    from horovod_tpu.core.engine import bucket_by_size
+    from horovod_tpu.ops import collectives as C
+    from horovod_tpu.ops import compression as hvd_comp
+    from horovod_tpu.parallel.mesh import Topology
+    import numpy as _np
+    topo = Topology(size=size, local_size=local, platform="tpu",
+                    source="projection")
+    buckets = bucket_by_size(leaves, threshold)
+    out = {"none": {}, codec: {}}
+    for idxs in buckets:
+        nb = sum(leaves[i].nbytes for i in idxs)
+        algo = C.choose_algorithm("allreduce", nb, topo)
+        bc = hvd_comp.resolve_codec(codec, leaves[idxs[0]].dtype)
+        for key, c in (("none", hvd_comp.CODEC_NONE), (codec, bc)):
+            for i in idxs:
+                it = _np.dtype(leaves[i].dtype).itemsize
+                for link, v in C.link_split(algo, leaves[i].nbytes,
+                                            local, codec=c,
+                                            itemsize=it).items():
+                    out[key][link] = out[key].get(link, 0) + int(v)
+    return out
+
+
 def bench_transformer():
     """Flagship transformer-LM MFU (decoder LM, bf16, flash attention, lean
     logsumexp loss). Timed as the marginal cost of extra scan steps inside
@@ -276,6 +309,27 @@ def bench_transformer():
         "transformer_timing": f"scan_marginal_median_of_{n_used}",
         "transformer_spread_pct": round(spread, 1),
     }
+    # link-labeled gradient wire bytes, before/after the int8 wire codec
+    # (ISSUE 13): the model's real parameter set bucketed and split by
+    # the registry's link rules on the reference 8x4 hierarchical fabric
+    try:
+        from horovod_tpu.models.transformer import init_params
+        from horovod_tpu.optimizer import _SizeProxy
+        from horovod_tpu.common.env import Config as _Cfg
+        shapes = jax.eval_shape(
+            lambda k: init_params(k, cfg), jax.random.PRNGKey(0))
+        leaves = [_SizeProxy(l.shape, l.dtype)
+                  for l in jax.tree_util.tree_leaves(shapes)]
+        proj = _hier_wire_projection(
+            leaves, _Cfg.from_env().fusion_threshold_bytes)
+        out["transformer_dcn_wire_bytes_per_step"] = \
+            proj["none"].get("dcn", 0)
+        out["transformer_dcn_wire_bytes_per_step_int8"] = \
+            proj["int8"].get("dcn", 0)
+        out["transformer_wire_projection"] = "hier8x4_registry_rules"
+    except Exception as e:
+        out["transformer_wire_projection_error"] = \
+            f"{type(e).__name__}: {e}"
     try:
         rb = int(os.environ.get("BENCH_LM_REMAT_BATCH", "8"))
         rcfg = dataclasses.replace(cfg, remat="block")
@@ -600,7 +654,7 @@ def _size_label(nbytes: int) -> str:
 
 
 def bench_busbw(sizes_bytes=None, kinds=("allreduce", "allgather"),
-                iters=8):
+                iters=8, codecs=("none", "int8")):
     """Bus-bandwidth message-size sweep vs the topology roofline
     (ISSUE 10 acceptance surface).
 
@@ -619,6 +673,14 @@ def bench_busbw(sizes_bytes=None, kinds=("allreduce", "allgather"),
     ``collective_algo_selected`` mapping each band to its chosen
     algorithm. Timing uses the PR 6 noise-escalation pattern (doubling
     iteration spans, cap 2 escalations, keep the quietest reading).
+
+    ``codecs`` (ISSUE 13) grows per-codec bands for the allreduce sweep:
+    every non-"none" codec runs the SAME selected lowering with its wire
+    codec live, emitting ``busbw_<band>_<codec>`` as *effective* bus
+    bandwidth (the uncompressed-payload convention, so a codec that
+    halves wall time doubles the number) plus one aggregate
+    ``effective_busbw_gain_pct`` per codec — achieved speedup over the
+    uncompressed band, averaged across the allreduce sizes.
     """
     import numpy as np
     import jax
@@ -707,6 +769,48 @@ def bench_busbw(sizes_bytes=None, kinds=("allreduce", "allgather"),
             out[f"busbw_{band}_spread_pct"] = round(spread, 1)
             out[f"busbw_roofline_{band}"] = round(
                 topo.roofline_busbw_gbps(kind, algo), 3)
+            if kind != "allreduce":
+                continue
+            # per-codec effective-bandwidth bands (ISSUE 13): the same
+            # selected lowering with the wire codec live — effective
+            # busbw keeps the UNCOMPRESSED payload in the numerator, so
+            # the codec's wall-time win reads directly as a bandwidth
+            # multiple next to the same roofline
+            from horovod_tpu.ops import compression as hvd_comp
+            for codec in codecs:
+                rc = hvd_comp.resolve_codec(codec, np.float32)
+                if rc == hvd_comp.CODEC_NONE:
+                    continue
+                cfn = C.build_grouped_allreduce(
+                    mesh, "world", ReduceOp.SUM, ((elems,),),
+                    [jnp.float32], [[0]], local_size=topo.local_size,
+                    algos=(algo,), codecs=(rc,))
+                cargs = [arg]
+                if rc in hvd_comp.EF_CODECS:
+                    res_elems = C.codec_residual_elems(
+                        "reduce", elems, n, topo.local_size, algo, rc)
+                    cargs.append(jax.device_put(
+                        jnp.zeros((res_elems,), jnp.float32),
+                        NamedSharding(mesh, P())))
+                crun = (lambda cfn=cfn, cargs=cargs: cfn(*cargs)[0])
+                crun()
+                cdt, cspread, cesc = measure(crun, iters)
+                total_escalations += cesc
+                out[f"busbw_{band}_{codec}"] = round(
+                    factor * payload / cdt / 1e9, 3)
+                out[f"busbw_{band}_{codec}_spread_pct"] = round(
+                    cspread, 1)
+                out.setdefault("_codec_gains", {}).setdefault(
+                    codec, []).append(100.0 * (dt / cdt - 1.0))
+    gains = out.pop("_codec_gains", {})
+    for codec, vals in gains.items():
+        out[f"effective_busbw_gain_pct_{codec}"] = round(
+            sum(vals) / len(vals), 1)
+    if gains:
+        # headline field: the configured (or first swept) codec's mean gain
+        first = next(iter(gains))
+        out["effective_busbw_gain_pct"] = round(
+            sum(gains[first]) / len(gains[first]), 1)
     out["collective_algo_selected"] = selected
     out["busbw_escalations"] = total_escalations
     out["busbw_timing"] = f"median_of_3_spans_x{iters}_iters"
@@ -1010,6 +1114,12 @@ def main():
     d_bucket_bytes = _ctr(m1, "hvd_tpu_fusion_bucket_bytes_total") \
         - _ctr(m0, "hvd_tpu_fusion_bucket_bytes_total")
     thr = max(eng.config.fusion_threshold_bytes, 1)
+    def _link_tot(snap, link):
+        ent = snap.get("counters", {}).get("hvd_tpu_wire_bytes_total")
+        if not ent:
+            return 0.0
+        return sum(v for l, v in ent["values"] if l.get("link") == link)
+
     registry_telemetry = {
         "dispatch_count_per_step": int(
             _ctr(m1, "hvd_tpu_dispatches_total")
@@ -1017,10 +1127,46 @@ def main():
         "wire_bytes_per_step": int(
             _ctr(m1, "hvd_tpu_wire_bytes_total")
             - _ctr(m0, "hvd_tpu_wire_bytes_total")),
+        "dcn_wire_bytes_per_step": int(
+            _link_tot(m1, "dcn") - _link_tot(m0, "dcn")),
         "bucket_fill_pct": (round(
             100.0 * d_bucket_bytes / (d_buckets * thr), 2)
             if d_buckets else None),
     }
+    # the same eager step under the int8 wire codec (ISSUE 13): measured
+    # registry deltas — on a hierarchical multi-process world the dcn
+    # series drops ~4x at unchanged ici bytes; the one-process dev rig
+    # moves no DCN bytes, so the projected 8x4 numbers ride along
+    prev_codec = eng.config.compression
+    try:
+        eng.config.compression = "int8"
+        c0 = hvd_metrics.snapshot()
+        eager_step(params, batch_stats, eager_opt_state, images, labels)
+        c1 = hvd_metrics.snapshot()
+        registry_telemetry["wire_bytes_per_step_compressed"] = int(
+            _ctr(c1, "hvd_tpu_wire_bytes_total")
+            - _ctr(c0, "hvd_tpu_wire_bytes_total"))
+        registry_telemetry["dcn_wire_bytes_per_step_compressed"] = int(
+            _link_tot(c1, "dcn") - _link_tot(c0, "dcn"))
+        registry_telemetry["compression_bytes_saved_per_step"] = int(
+            _ctr(c1, "hvd_tpu_compression_bytes_saved_total")
+            - _ctr(c0, "hvd_tpu_compression_bytes_saved_total"))
+    finally:
+        eng.config.compression = prev_codec
+    try:
+        from horovod_tpu.optimizer import _SizeProxy
+        g_leaves = jax.tree_util.tree_leaves(
+            grad_fn(params, batch_stats, images, labels)[1])
+        proj = _hier_wire_projection(
+            [_SizeProxy(l.shape, l.dtype) for l in g_leaves],
+            eng.config.fusion_threshold_bytes)
+        registry_telemetry["dcn_wire_bytes_per_step_hier8x4"] = \
+            proj["none"].get("dcn", 0)
+        registry_telemetry["dcn_wire_bytes_per_step_hier8x4_int8"] = \
+            proj["int8"].get("dcn", 0)
+    except Exception as e:
+        registry_telemetry["wire_projection_error"] = \
+            f"{type(e).__name__}: {e}"
 
     # ---- eager path under step-capture replay -----------------------------
     # Identical step, but bracketed by step_begin/step_end: after

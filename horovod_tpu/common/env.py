@@ -160,6 +160,17 @@ HOROVOD_TPU_LOCAL_SIZE = "HOROVOD_TPU_LOCAL_SIZE"
 # auto mode lowers a reduction bucket to the tree form when its payload is
 # at most this many bytes (latency-bound regime; ring bandwidth wins above)
 HOROVOD_TPU_TREE_THRESHOLD_BYTES = "HOROVOD_TPU_TREE_THRESHOLD_BYTES"
+# link-aware gradient compression (ISSUE 13, ops/compression.py +
+# ops/collectives.py codec reducers): the wire codec applied to reduction
+# payloads — "none" (default), "bf16" (cast, 2 bytes/elem), or the
+# error-feedback "fp8"/"int8" (1 byte/elem, residual-carrying). On the
+# hierarchical ladder only the cross-slice DCN exchange is encoded (ICI
+# legs stay full precision); flat/tree selections encode the whole
+# payload. Non-float buckets are never quantized. Also an autotune
+# categorical ("compression": env-resolved codec vs none — only offered
+# when the user enabled a codec). Resolved once per engine; the
+# optimizer's compression= argument overrides per call.
+HOROVOD_TPU_COMPRESSION = "HOROVOD_TPU_COMPRESSION"
 # async sharded checkpointing (ISSUE 9, horovod_tpu/checkpoint/): setting
 # the directory enables the durable tier — TPUState commits snapshot
 # through the CheckpointManager and elastic recovery falls back to the
@@ -197,6 +208,7 @@ DEFAULT_OVERLAP_STAGE_BYTES = 8 * 1024 * 1024
 OVERLAP_PIPELINE_MODES = ("auto", "off", "interleave", "staged")
 DEFAULT_TREE_THRESHOLD_BYTES = 256 * 1024
 COLLECTIVE_ALGO_MODES = ("auto", "flat", "tree", "hierarchical")
+COMPRESSION_MODES = ("none", "bf16", "fp8", "int8")
 _XLA_LHS_FLAG = "--xla_tpu_enable_latency_hiding_scheduler=true"
 
 
@@ -335,6 +347,7 @@ class Config:
     zero1_prefetch: bool = True
     collective_algo: str = "auto"
     tree_threshold_bytes: int = DEFAULT_TREE_THRESHOLD_BYTES
+    compression: str = "none"
     # NOTE: the HOROVOD_TPU_METRICS on/off switch is read by
     # metrics.metrics_enabled() (the registry outlives any Config); only
     # the emitter knobs live here
@@ -396,6 +409,8 @@ class Config:
             tree_threshold_bytes=_get_int(
                 HOROVOD_TPU_TREE_THRESHOLD_BYTES,
                 DEFAULT_TREE_THRESHOLD_BYTES),
+            compression=_get_choice(
+                HOROVOD_TPU_COMPRESSION, "none", COMPRESSION_MODES),
             metrics_file=os.environ.get(HOROVOD_TPU_METRICS_FILE) or None,
             metrics_interval=_get_float(HOROVOD_TPU_METRICS_INTERVAL, 10.0),
             trace_enabled=_get_bool(HOROVOD_TPU_TRACE, True),

@@ -116,6 +116,19 @@ KNOB_SPECS: Dict[str, dict] = {
                 "over ICI, 1/local_size cross-slice exchange over DCN, "
                 "AG back) per (bytes, topology); forced values demote to "
                 "flat with a one-time WARNING when invalid."},
+    "HOROVOD_TPU_COMPRESSION": {
+        "type": "choice", "default": "none",
+        "choices": ("none", "bf16", "fp8", "int8"),
+        "help": "Link-aware wire codec for reduction payloads (ISSUE "
+                "13): bf16 casts (2 bytes/elem); fp8/int8 quantize with "
+                "error feedback (1 byte/elem, a rank-local residual per "
+                "fusion bucket carries the quantization error forward). "
+                "On the hierarchical ladder only the cross-slice DCN "
+                "exchange is encoded — ICI legs stay full precision; "
+                "flat/tree lowerings encode the whole payload. Non-float "
+                "buckets are never quantized; fp8 demotes to int8 on jax "
+                "builds without a float8 dtype. Also an autotune "
+                "categorical (codec vs none) when enabled."},
     "HOROVOD_TPU_LOCAL_SIZE": {
         "type": "int", "default": "derived",
         "help": "Topology override: ranks per fast-fabric island "

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import logging
 import os
+import re
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
@@ -39,8 +40,13 @@ from ..common.lru import lru_get, lru_put, lru_touch
 from ..common.reduce_ops import ReduceOp
 from ..metrics import registry as metrics_registry
 from ..ops import collectives as C
+from ..ops import compression as comp
 from ..parallel.mesh import WORLD_AXIS, detect_topology
 from .backend import Backend
+
+# residual-lineage name templates share replay's digit normalization
+# ("grad.s17" and "grad.s18" are the same logical per-step call)
+_DIGITS = re.compile(r"\d+")
 
 
 def _translate_failure(fn, *args, **kwargs):
@@ -303,6 +309,7 @@ class Engine:
     _GUARDED_BY = {
         "_outstanding": "_lock",
         "_zero1_prefetch": "_lock",
+        "_ef_residuals": "_lock",
     }
 
     def __init__(self, backend: Backend, config: env_mod.Config):
@@ -409,6 +416,20 @@ class Engine:
         from ..ops.pallas_kernels import pack_pallas_enabled
         self._pack_pallas_base = pack_pallas_enabled()
         self._m_algo = _reg.counter("hvd_tpu_collective_algo_total")
+        # Link-aware gradient compression (ISSUE 13): the wire-codec base
+        # is resolved ONCE here (divcheck discipline — the autotune
+        # categorical toggles it live, broadcast-synced); error-feedback
+        # residual buffers live in _ef_residuals, keyed per logical
+        # fusion bucket, written on the dispatch path and invalidated
+        # from replay/join/elastic edges exactly like the ZeRO-1
+        # prefetch legs (invalidate, never poison).
+        self._codec_base = config.compression
+        self._m_codec = _reg.counter("hvd_tpu_compression_codec_total")
+        self._m_saved = _reg.counter(
+            "hvd_tpu_compression_bytes_saved_total")
+        self._m_res_inval = _reg.counter(
+            "hvd_tpu_compression_residual_invalidations_total")
+        self._ef_residuals: Dict[tuple, dict] = {}
         self._zero1_prefetch: Dict[tuple, dict] = {}
         self._in_step_bracket = False
         self._overlap_step_noted = False
@@ -586,9 +607,141 @@ class Engine:
         to re-arm on any move."""
         cfg = self.config
         return (cfg.collective_algo, cfg.tree_threshold_bytes,
-                cfg.hierarchical_allreduce, cfg.hierarchical_allgather)
+                cfg.hierarchical_allreduce, cfg.hierarchical_allgather,
+                cfg.compression)
 
-    def _tensor_links(self, kind: str, tensors, buckets=None, algos=None):
+    # -- link-aware gradient compression (ISSUE 13) ------------------------
+
+    def _call_codec(self, override: Optional[str],
+                    op: Optional[ReduceOp] = None) -> str:
+        """The call-level wire codec: the explicit per-call override (the
+        optimizer's ``compression=`` argument, carried in the replay sig
+        so armed programs match) or the engine knob
+        (HOROVOD_TPU_COMPRESSION / the autotune categorical). "none" on
+        size<=1 worlds and for non-additive reductions — only SUM and
+        AVERAGE have a decode-sum decomposition."""
+        if self.topology.size <= 1:
+            return comp.CODEC_NONE
+        if op is not None and op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            return comp.CODEC_NONE
+        base = override if override is not None else self.config.compression
+        return base if base in comp.CODECS else comp.CODEC_NONE
+
+    def _bucket_codecs(self, kind: str, tensors, buckets, call_codec: str,
+                       count: bool = True) -> tuple:
+        """Per-fusion-bucket codec resolution (deterministic in
+        (call codec, bucket dtype) — every rank resolves the same
+        program; non-float buckets are never quantized). ``count=True``
+        records the selections in hvd_tpu_compression_codec_total."""
+        if call_codec == comp.CODEC_NONE:
+            return (comp.CODEC_NONE,) * len(buckets)
+        out = tuple(comp.resolve_codec(call_codec,
+                                       tensors[idxs[0]].dtype)
+                    for idxs in buckets)
+        if count and self._m_enabled:
+            for c in out:
+                self._m_codec.inc(kind=kind, codec=c)
+        return out
+
+    def _residual_key(self, tag: str, name: Optional[str], bucket: int,
+                      algo: str, codec: str, elems: int,
+                      dtype_str: str) -> tuple:
+        """Identity of one error-feedback residual lineage: the
+        digit-normalized call name (the optimizer's per-step names
+        collapse to one template) plus the bucket's position, lowering,
+        codec, and shape. Replay's armed programs derive the same keys
+        from their captured sigs, so residual lineage carries across the
+        eager-warmup -> replay transition for single-call steps."""
+        return (tag, _DIGITS.sub("#", name or ""), bucket, algo, codec,
+                int(elems), dtype_str)
+
+    def _grouped_residuals(self, tag: str, name: Optional[str], tensors,
+                           buckets, algos, codecs) -> list:
+        """Residual bookkeeping rows for one grouped call: ``(bucket,
+        key, elems, dtype)`` per error-feedback bucket, in bucket order —
+        exactly the order the builders append residual I/O in."""
+        out = []
+        n = self.topology.size
+        local = self.topology.local_size
+        for b, (idxs, algo, codec) in enumerate(zip(buckets, algos,
+                                                    codecs)):
+            if codec not in comp.EF_CODECS:
+                continue
+            total = sum(int(tensors[i].size) for i in idxs)
+            elems = C.codec_residual_elems("reduce", total, n, local,
+                                           algo, codec)
+            dt = str(tensors[idxs[0]].dtype)
+            out.append((b, self._residual_key(tag, name, b, algo, codec,
+                                              elems, dt), elems, dt))
+        return out
+
+    def _residual_fetch(self, key: tuple, elems: int, dtype):
+        """This rank's residual buffer for one EF bucket — zeros on first
+        use, after invalidation, or on any shape drift (a fusion-layout
+        move makes the old residual meaningless; starting fresh only
+        costs one step of compression error)."""
+        with self._lock:
+            ent = self._ef_residuals.get(key)
+            if ent is not None and ent["world_version"] == \
+                    self.world_version:
+                buf = ent["buf"]
+                if int(buf.shape[0]) == int(elems):
+                    return buf
+        return jnp.zeros((int(elems),), jnp.dtype(dtype))
+
+    def _residual_store(self, key: tuple, garr) -> None:
+        # from_replicated is a zero-dispatch shard read: the stored value
+        # is this rank's own new residual (the P() out-spec claims
+        # replication the world-view convention never relies on)
+        buf = self.backend.from_replicated(garr)
+        with self._lock:
+            self._ef_residuals[key] = {
+                "world_version": self.world_version, "buf": buf}
+            while len(self._ef_residuals) > self.config.cache_capacity:
+                self._ef_residuals.pop(next(iter(self._ef_residuals)))
+
+    def invalidate_residuals(self, reason: str) -> None:
+        """Drop every error-feedback residual buffer (join(), elastic
+        world-version bumps, explicit resets — the prefetch-leg
+        invalidation contract: invalidate, never poison; the next
+        compressed step simply starts a fresh lineage)."""
+        with self._lock:
+            dropped = len(self._ef_residuals)
+            self._ef_residuals.clear()
+        if dropped:
+            self._m_res_inval.inc(dropped)
+            self._emit_replay("residual-invalidate", reason)
+
+    def _m_codec_saved(self, kind: str, tensors, buckets, algos,
+                       codecs, links=None) -> None:
+        """Wire bytes the codecs removed, by link — the measurable face
+        of the compression win next to the (already-encoded)
+        hvd_tpu_wire_bytes_total series. Both series follow the
+        registry's submitted-payload convention (what this rank hands to
+        the collective, each byte once — the same convention the
+        uncompressed ladder is booked under, so before/after deltas stay
+        apples-to-apples). ``links`` reuses a per-tensor encoded split
+        the caller already derived (:meth:`_tensor_links`)."""
+        if not self._m_enabled:
+            return
+        local = self.topology.local_size
+        for idxs, algo, codec in zip(buckets, algos, codecs):
+            if codec == comp.CODEC_NONE:
+                continue
+            for i in idxs:
+                t = tensors[i]
+                orig = C.link_split(algo, t.nbytes, local, kind=kind)
+                enc = (links[i] if links is not None and links[i]
+                       else C.link_split(
+                           algo, t.nbytes, local, kind=kind, codec=codec,
+                           itemsize=jnp.dtype(t.dtype).itemsize))
+                for link, b in orig.items():
+                    saved = b - enc.get(link, 0)
+                    if saved > 0:
+                        self._m_saved.inc(saved, link=link)
+
+    def _tensor_links(self, kind: str, tensors, buckets=None, algos=None,
+                      codecs=None):
         """Per-tensor link-byte split for wire accounting and trace
         stamping: each tensor inherits its fusion bucket's algorithm.
         ``buckets=None`` derives the live bucketing (the same rule the
@@ -605,12 +758,16 @@ class Engine:
                                      self.config.fusion_threshold_bytes)
         if algos is None:
             algos = self._bucket_algos(kind, tensors, buckets)
+        if codecs is None:
+            codecs = (comp.CODEC_NONE,) * len(buckets)
         local = self.topology.local_size
         links = [None] * len(tensors)
-        for idxs, algo in zip(buckets, algos):
+        for idxs, algo, codec in zip(buckets, algos, codecs):
             for i in idxs:
-                links[i] = C.link_split(algo, tensors[i].nbytes, local,
-                                        kind=kind)
+                links[i] = C.link_split(
+                    algo, tensors[i].nbytes, local, kind=kind,
+                    codec=codec,
+                    itemsize=jnp.dtype(tensors[i].dtype).itemsize)
         return links
 
     def _m_account(self, kind: str, tensors, links=None):
@@ -809,17 +966,26 @@ class Engine:
         self._emit_replay("prefetch-invalidate", reason)
 
     def _prefetch_gc(self) -> None:
-        """Drop held legs whose world version is stale (an elastic bump
-        observed outside the replay step markers)."""
+        """Drop held legs — and error-feedback residual buffers — whose
+        world version is stale (an elastic bump observed outside the
+        replay step markers)."""
         v = self.world_version
         with self._lock:
             stale = [k for k, ent in self._zero1_prefetch.items()
                      if ent["world_version"] != v]
             for k in stale:
                 del self._zero1_prefetch[k]
+            stale_res = [k for k, ent in self._ef_residuals.items()
+                         if ent["world_version"] != v]
+            for k in stale_res:
+                del self._ef_residuals[k]
         if stale:
             self._m_prefetch_inval.inc(len(stale))
             self._emit_replay("prefetch-invalidate",
+                              f"world-version bump (-> {v})")
+        if stale_res:
+            self._m_res_inval.inc(len(stale_res))
+            self._emit_replay("residual-invalidate",
                               f"world-version bump (-> {v})")
 
     def _emit_replay(self, event: str, detail: str):
@@ -871,6 +1037,15 @@ class Engine:
             self.config.collective_algo = (
                 self._algo_base
                 if pm.categorical_value("collective_algo") else "flat")
+        # compression is the same boolean-over-string pattern: the
+        # categorical explores the env-resolved codec vs no compression
+        # (only offered when the user enabled a codec — autotune never
+        # silently turns lossy compression ON, state.py)
+        if pm.tunes("compression"):
+            self.config.compression = (
+                self._codec_base
+                if pm.categorical_value("compression")
+                else comp.CODEC_NONE)
 
     def _dispatch(self, names, fn, *args):
         """Dispatch with failure translation + a timeline ACTIVITY span per
@@ -1040,13 +1215,23 @@ class Engine:
 
         self._join_substitute = True
         if kind == "grouped_allreduce":
-            op = ReduceOp(int(metas[0][0]))
-            hs = self.grouped_allreduce([zero(r) for r in metas], op=op)
+            # the advertised op field packs the call codec in its high
+            # bits (allreduce/grouped_allreduce submission sites): the
+            # substitute must compile the SAME compressed program as the
+            # active ranks or the collective sequences diverge
+            code = int(metas[0][0])
+            op = ReduceOp(code & 15)
+            sub_codec = comp.CODECS[(code >> 4) % len(comp.CODECS)]
+            hs = self.grouped_allreduce([zero(r) for r in metas], op=op,
+                                        codec=sub_codec)
             for h in hs:
                 h.synchronize()
         elif kind == "allreduce":
-            self.allreduce(zero(metas[0]),
-                           op=ReduceOp(int(metas[0][0]))).synchronize()
+            code = int(metas[0][0])
+            self.allreduce(
+                zero(metas[0]), op=ReduceOp(code & 15),
+                codec=comp.CODECS[(code >> 4) % len(comp.CODECS)]
+            ).synchronize()
         elif kind == "adasum":
             from ..ops.adasum import adasum_allreduce_handle
             adasum_allreduce_handle(self, zero(metas[0])).synchronize()
@@ -1252,29 +1437,73 @@ class Engine:
     def allreduce(self, tensor, name: Optional[str] = None,
                   op: ReduceOp = ReduceOp.SUM,
                   prescale_factor: float = 1.0,
-                  postscale_factor: float = 1.0) -> Handle:
+                  postscale_factor: float = 1.0,
+                  codec: Optional[str] = None) -> Handle:
         x = jnp.asarray(tensor)
+        orig_name = name   # residual-lineage template (pre-registration)
         sub = self._consume_substitute()
         _check_average_dtype(x, op)
         algo, links = C.ALGO_FLAT, None
+        call_codec = self._call_codec(codec, op)
+        bucket_codec = comp.CODEC_NONE
         if self.topology.size > 1:
             algo = self._choose_algo("allreduce", x.nbytes)
+            bucket_codec = self._bucket_codecs("allreduce", [x], [[0]],
+                                               call_codec)[0]
             if self._m_enabled:
                 self._m_algo.inc(kind="allreduce", algo=algo)
             if self._m_enabled or self.trace is not None:
                 links = [C.link_split(algo, x.nbytes,
-                                      self.topology.local_size)]
+                                      self.topology.local_size,
+                                      codec=bucket_codec,
+                                      itemsize=jnp.dtype(
+                                          x.dtype).itemsize)]
+            self._m_codec_saved("allreduce", [x], [[0]], (algo,),
+                                (bucket_codec,), links)
         self._m_account("allreduce", [x], links)
         r = self._replay.intercept("allreduce", [x], int(op),
                                    prescale_factor, postscale_factor, name,
-                                   sub)
+                                   sub, extra=(call_codec,))
         if r is not None:
             return r[0]
         name = self._register(name, "allreduce", x.nbytes,
                               link_bytes=links[0] if links else None)
-        self._join_sync("allreduce", [_join_meta_row(x, int(op))], skip=sub)
+        # the advertised op field carries the call codec in its high bits
+        # so a joined peer's zero substitute resolves the SAME compressed
+        # program (ReduceOp codes fit in 4 bits)
+        self._join_sync("allreduce",
+                        [_join_meta_row(
+                            x, int(op)
+                            | (comp.CODECS.index(call_codec) << 4))],
+                        skip=sub)
         self._debug_check(name, "allreduce", [x], op_code=int(op),
                           wildcard=sub)
+        if bucket_codec != comp.CODEC_NONE:
+            failpoint("compression.encode")
+            elems = C.codec_residual_elems(
+                "reduce", int(np.prod(x.shape)) if x.ndim else 1,
+                self.topology.size, self.topology.local_size, algo,
+                bucket_codec)
+            fn = self._builder(
+                ("codec_allreduce", op, prescale_factor, postscale_factor,
+                 tuple(x.shape), str(x.dtype), algo, bucket_codec),
+                lambda: C.build_codec_allreduce(
+                    self.backend.group_mesh, self._axis(), op,
+                    tuple(x.shape), x.dtype, algo, bucket_codec,
+                    prescale_factor, postscale_factor,
+                    self.topology.local_size))
+            if bucket_codec in comp.EF_CODECS:
+                key = self._residual_key("gar", orig_name, 0, algo,
+                                         bucket_codec, elems, str(x.dtype))
+                res = self._residual_fetch(key, elems, x.dtype)
+                out, new_res = self._dispatch(
+                    name, lambda: fn(self.backend.to_global(x),
+                                     self.backend.world_view(res)))
+                self._residual_store(key, new_res)
+            else:
+                out = self._dispatch(
+                    name, lambda: fn(self.backend.to_global(x)))
+            return self._single(name, out, kind="allreduce")
         fn = self._allreduce_builder(op, prescale_factor, postscale_factor,
                                      algo)
         out = self._dispatch(name, lambda: fn(self.backend.to_global(x)))
@@ -1283,16 +1512,20 @@ class Engine:
     def grouped_allreduce(self, tensors: Sequence, name: Optional[str] = None,
                           op: ReduceOp = ReduceOp.SUM,
                           prescale_factor: float = 1.0,
-                          postscale_factor: float = 1.0) -> List[Handle]:
+                          postscale_factor: float = 1.0,
+                          codec: Optional[str] = None) -> List[Handle]:
         """Fused allreduce of many tensors: bucketed packing (one collective per
         <= fusion_threshold bucket per dtype), mirroring FuseResponses
-        (controller.cc:652-773)."""
+        (controller.cc:652-773). ``codec`` overrides the engine's wire
+        codec for this call (the optimizer's ``compression=`` argument,
+        ISSUE 13); None defers to HOROVOD_TPU_COMPRESSION."""
         tensors = [jnp.asarray(t) for t in tensors]
         sub = self._consume_substitute()
         for t in tensors:
             _check_average_dtype(t, op)
         links = None
-        derived = None   # (threshold, buckets, algos) for dispatch reuse
+        call_codec = self._call_codec(codec, op)
+        derived = None   # (threshold, sig, buckets, algos, codecs) reuse
         if tensors:
             # selection + link attribution ride the live bucketing; wire
             # accounting stays BEFORE replay interception so replayed
@@ -1303,16 +1536,27 @@ class Engine:
                 thr0 = self.config.fusion_threshold_bytes
                 b0 = bucket_by_size(tensors, thr0)
                 a0 = self._bucket_algos("allreduce", tensors, b0)
-                links = self._tensor_links("allreduce", tensors, b0, a0)
-                derived = (thr0, self._algo_sig(), b0, a0)
+                c0 = self._bucket_codecs("grouped_allreduce", tensors, b0,
+                                         call_codec)
+                links = self._tensor_links("allreduce", tensors, b0, a0,
+                                           c0)
+                self._m_codec_saved("allreduce", tensors, b0, a0, c0,
+                                    links)
+                derived = (thr0, self._algo_sig(), b0, a0, c0)
             self._m_account("grouped_allreduce", tensors, links)
             r = self._replay.intercept("grouped_allreduce", tensors, int(op),
                                        prescale_factor, postscale_factor,
-                                       name, sub)
+                                       name, sub, extra=(call_codec,))
             if r is not None:
                 return r
+        # the advertised op field carries the call codec in its high bits
+        # (see allreduce) so a joined peer's substitute compiles the same
+        # compressed program
         self._join_sync("grouped_allreduce",
-                        [_join_meta_row(t, int(op)) for t in tensors],
+                        [_join_meta_row(
+                            t, int(op)
+                            | (comp.CODECS.index(call_codec) << 4))
+                         for t in tensors],
                         skip=sub)
         self._pm_step(sum(t.nbytes for t in tensors))
         names = [self._register(None if name is None else f"{name}.{i}",
@@ -1326,7 +1570,7 @@ class Engine:
         if derived is not None \
                 and derived[0] == self.config.fusion_threshold_bytes \
                 and derived[1] == self._algo_sig():
-            buckets, algos = derived[2], derived[3]
+            buckets, algos, codecs = derived[2], derived[3], derived[4]
         else:
             # _pm_step retuned a selection knob mid-call (or size-1
             # world): re-derive so THIS call's buckets and algorithms
@@ -1336,7 +1580,19 @@ class Engine:
                                      self.config.fusion_threshold_bytes)
             algos = self._bucket_algos("allreduce", tensors, buckets,
                                        count=False)
+            codecs = self._bucket_codecs("grouped_allreduce", tensors,
+                                         buckets, call_codec, count=False)
         self._m_buckets_obs(tensors, buckets)
+        if any(c != comp.CODEC_NONE for c in codecs):
+            failpoint("compression.encode")
+        # ONE residual-row derivation for both dispatch forms below: the
+        # single-launch and per-bucket paths must produce identical keys
+        # or error-feedback lineage would silently reset on a
+        # single_launch flip (_residual_fetch returns zeros on any
+        # key/shape mismatch)
+        ef_info = self._grouped_residuals("gar", name, tensors, buckets,
+                                          algos, codecs)
+        ef_by_bucket = {row[0]: row for row in ef_info}
         mesh = self.backend.group_mesh
         hier_local = self.topology.local_size
         from ..ops.pallas_kernels import pack_pallas
@@ -1372,16 +1628,21 @@ class Engine:
             fn = self._builder(
                 ("grouped_allreduce", op, prescale_factor,
                  postscale_factor, shapes, dtypes, bkey, hier_local, pipe,
-                 algos),
+                 algos, codecs),
                 lambda: C.build_grouped_allreduce(
                     mesh, self._axis(), op, shapes,
                     [t.dtype for t in tensors], buckets,
                     prescale_factor, postscale_factor, hier_local,
-                    pipeline=pipe, algos=algos))
+                    pipeline=pipe, algos=algos, codecs=codecs))
+            res_args = [self.backend.world_view(
+                self._residual_fetch(k, e, dt))
+                for _, k, e, dt in ef_info]
             outs = self._dispatch(
                 names,
-                lambda: fn(*[self.backend.to_global(p, batched=True)
-                             for p in packed]))
+                lambda: fn(*([self.backend.to_global(p, batched=True)
+                              for p in packed] + res_args)))
+            for j, (_, k, _, _) in enumerate(ef_info):
+                self._residual_store(k, outs[len(tensors) + j])
             group = LaunchGroup(outs[-1])
             for i in range(len(tensors)):
                 results[i] = (outs[i], group)
@@ -1394,6 +1655,7 @@ class Engine:
                 shapes = tuple(tuple(t.shape) for t in bucket)
                 dtype = bucket[0].dtype
                 algo = algos[b]
+                bcodec = codecs[b]
                 self._count_dispatch()
                 if use_pallas_pack:
                     packed = _translate_failure(pack_pallas, bucket)
@@ -1405,14 +1667,24 @@ class Engine:
                 fn = self._builder(
                     ("fused_allreduce", op, prescale_factor,
                      postscale_factor, shapes, str(dtype), hier_local,
-                     algo),
+                     algo, bcodec),
                     lambda: C.build_fused_allreduce(
                         mesh, self._axis(), op, shapes, dtype,
                         prescale_factor, postscale_factor, hier_local,
-                        algo=algo))
-                outs = self._dispatch(
-                    [names[i] for i in idxs],
-                    lambda: fn(self.backend.to_global(packed)))
+                        algo=algo, codec=bcodec))
+                if bcodec in comp.EF_CODECS:
+                    _, key, elems, _dt = ef_by_bucket[b]
+                    res = self._residual_fetch(key, elems, dtype)
+                    outs = self._dispatch(
+                        [names[i] for i in idxs],
+                        lambda: fn(self.backend.to_global(packed),
+                                   self.backend.world_view(res)))
+                    self._residual_store(key, outs[-1])
+                    outs = outs[:-1]
+                else:
+                    outs = self._dispatch(
+                        [names[i] for i in idxs],
+                        lambda: fn(self.backend.to_global(packed)))
                 group = LaunchGroup(outs[-1])
                 for pos, i in enumerate(idxs):
                     results[i] = (outs[pos], group)
@@ -1440,7 +1712,8 @@ class Engine:
                      op: ReduceOp = ReduceOp.AVERAGE,
                      prescale_factor: float = 1.0,
                      postscale_factor: float = 1.0,
-                     buckets: Optional[Sequence] = None) -> List[Handle]:
+                     buckets: Optional[Sequence] = None,
+                     codec: Optional[str] = None) -> List[Handle]:
         """ZeRO-1 optimizer-state-sharded gradient sync + update: bucket and
         pack the gradients (fusion logic of grouped_allreduce), reduce-
         scatter each bucket, run ``update_fn`` on this rank's shards only,
@@ -1478,14 +1751,29 @@ class Engine:
         ag_algos = self._bucket_algos("allgather", tensors, buckets)
         ag_links = self._tensor_links("allgather", tensors, buckets,
                                       ag_algos)
+        # wire codec (ISSUE 13): the GRADIENT reduce-scatter legs are
+        # compressed (pre-scatter encode, rank-local decode — ownership
+        # untouched); the parameter all-gather stays full precision
+        call_codec = self._call_codec(codec, op)
+        rs_codecs = self._bucket_codecs("reducescatter", tensors, buckets,
+                                        call_codec)
+        codec_of = {}
+        for idxs, c in zip(buckets, rs_codecs):
+            for i in idxs:
+                codec_of[i] = c
         # wire accounting: a sharded step moves each gradient bucket once
         # as a reduce-scatter and once back as the parameter all-gather
         if self._m_enabled:
             self._m_collectives.inc(1.0, kind="sharded_step")
             for _ in buckets:
                 self._m_algo.inc(kind="reducescatter", algo=C.ALGO_FLAT)
+            local = self.topology.local_size
             for i, t in enumerate(tensors):
-                self._m_wire.inc(t.nbytes, kind="reducescatter",
+                rs_split = C.link_split(
+                    C.ALGO_FLAT, t.nbytes, local, kind="reducescatter",
+                    codec=codec_of.get(i, comp.CODEC_NONE),
+                    itemsize=jnp.dtype(t.dtype).itemsize)
+                self._m_wire.inc(rs_split["flat"], kind="reducescatter",
                                  dtype=str(t.dtype), link="flat")
                 split = (ag_links[i] if ag_links
                          else {"flat": t.nbytes})
@@ -1493,6 +1781,8 @@ class Engine:
                     if b:
                         self._m_wire.inc(b, kind="allgather",
                                          dtype=str(t.dtype), link=link)
+            self._m_codec_saved("reducescatter", tensors, buckets,
+                                (C.ALGO_FLAT,) * len(buckets), rs_codecs)
         self._m_buckets_obs(tensors, buckets)
         # register BEFORE replay interception: a replayed launch resolves
         # the update closure from this registry at trace time. LRU-bounded
@@ -1504,7 +1794,8 @@ class Engine:
         r = self._replay.intercept("sharded_step", all_ts, int(op),
                                    prescale_factor, postscale_factor, name,
                                    sub,
-                                   extra=(update_key, len(tensors), bkey))
+                                   extra=(update_key, len(tensors), bkey,
+                                          call_codec))
         if r is not None:
             return r
         self._join_sync("sharded_step",
@@ -1512,11 +1803,17 @@ class Engine:
                         skip=sub)
         self._pm_step(sum(t.nbytes for t in tensors))
         def _sharded_link_bytes(i, t):
-            # a sharded tensor moves once over the flat rs ring and once
-            # back over the (possibly hierarchical) ag leg
+            # a sharded tensor moves once over the flat rs ring (encoded
+            # when a codec is live) and once back over the (possibly
+            # hierarchical) full-precision ag leg
             if i >= len(tensors):
                 return None
-            merged = {"flat": int(t.nbytes)}
+            rs = C.link_split(C.ALGO_FLAT, t.nbytes,
+                              self.topology.local_size,
+                              kind="reducescatter",
+                              codec=codec_of.get(i, comp.CODEC_NONE),
+                              itemsize=jnp.dtype(t.dtype).itemsize)
+            merged = {"flat": int(rs["flat"])}
             for link, b in (ag_links[i] if ag_links
                             else {"flat": int(t.nbytes)}).items():
                 merged[link] = merged.get(link, 0) + int(b)
@@ -1537,6 +1834,18 @@ class Engine:
                                 lambda: C.build_pack_group(buckets))
         self._count_dispatch()
         packed = _translate_failure(pack_fn, *tensors)
+        # error-feedback residual rows for the compressed rs legs, in
+        # bucket order (the builders' residual I/O order)
+        rs_ef = []
+        for b, (bidxs, bc) in enumerate(zip(buckets, rs_codecs)):
+            if bc in comp.EF_CODECS:
+                total = sum(int(tensors[i].size) for i in bidxs)
+                elems = C.codec_residual_elems(
+                    "sharded", total, self.topology.size, 0, None, bc)
+                rs_ef.append((b, ("zrs", update_key, b, bc, elems), elems,
+                              str(tensors[bidxs[0]].dtype)))
+        if any(c != comp.CODEC_NONE for c in rs_codecs):
+            failpoint("compression.encode")
         # overlap (ISSUE 6): a stale world version invalidates held
         # prefetch legs even when the caller runs outside step markers
         self._refresh_world_version()
@@ -1564,7 +1873,7 @@ class Engine:
             fn = self._builder(
                 ("sharded_step", op, prescale_factor, postscale_factor,
                  shapes, dtypes, bkey, st_shapes, st_dtypes, update_key,
-                 mode != "off", ag_algos),
+                 mode != "off", ag_algos, rs_codecs),
                 lambda: C.build_sharded_step(
                     mesh, self._axis(), op, shapes,
                     [t.dtype for t in tensors],
@@ -1572,13 +1881,18 @@ class Engine:
                     prescale_factor, postscale_factor,
                     pipeline=(mode != "off"),
                     local_size=self.topology.local_size,
-                    ag_algos=ag_algos))
+                    ag_algos=ag_algos, codecs=rs_codecs))
+            res_args = [self.backend.world_view(
+                self._residual_fetch(k, e, dt))
+                for _, k, e, dt in rs_ef]
             outs = self._dispatch(
                 names,
                 lambda: fn(*([self.backend.to_global(p, batched=True)
                               for p in packed]
                              + [self.backend.world_view(s)
-                                for s in state_leaves])))
+                                for s in state_leaves] + res_args)))
+            for j, (_, k, _, _) in enumerate(rs_ef):
+                self._residual_store(k, outs[len(all_ts) + j])
             group = LaunchGroup(outs[-1])
             handles = []
             for i, nm in enumerate(names):
@@ -1597,19 +1911,26 @@ class Engine:
         # across the step boundary (dropped on world-version bumps).
         upd_fn = self._builder(
             ("sharded_update", op, prescale_factor, postscale_factor,
-             shapes, dtypes, bkey, st_shapes, st_dtypes, update_key),
+             shapes, dtypes, bkey, st_shapes, st_dtypes, update_key,
+             rs_codecs),
             lambda: C.build_sharded_update(
                 mesh, self._axis(), op, shapes, [t.dtype for t in tensors],
                 buckets, st_shapes, st_dtypes, update_fn,
-                prescale_factor, postscale_factor, packed=True))
+                prescale_factor, postscale_factor, packed=True,
+                codecs=rs_codecs))
+        res_args = [self.backend.world_view(self._residual_fetch(k, e, dt))
+                    for _, k, e, dt in rs_ef]
         outs = self._dispatch(
             names,
             lambda: upd_fn(*([self.backend.to_global(p, batched=True)
                               for p in packed]
                              + [self.backend.world_view(s)
-                                for s in state_leaves])))
+                                for s in state_leaves] + res_args)))
         shard_garrs = outs[:len(buckets)]
-        state_garrs = outs[len(buckets):]
+        state_garrs = outs[len(buckets):len(buckets) + len(state_leaves)]
+        for j, (_, k, _, _) in enumerate(rs_ef):
+            self._residual_store(
+                k, outs[len(buckets) + len(state_leaves) + j])
         upd_group = LaunchGroup(outs[-1])
         failpoint("overlap.prefetch")
         ag_fn = self._builder(

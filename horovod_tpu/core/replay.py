@@ -47,6 +47,7 @@ import jax.numpy as jnp
 from ..common.lru import lru_get, lru_put
 from ..metrics import registry as metrics_registry
 from ..ops import collectives as _C
+from ..ops import compression as _comp
 
 # step counters in tensor names ("grad.s17", "bench.grad.42") must not make
 # otherwise-identical steps look distinct — normalize digit runs away
@@ -178,6 +179,12 @@ class _Armed(NamedTuple):
     # split stamped on the fused launch's trace event
     algo_sig: tuple = ()
     link_bytes: Optional[dict] = None
+    # link-aware wire compression (ISSUE 13): the error-feedback residual
+    # rows — (engine residual key, elems, dtype) in the replay program's
+    # residual I/O order — and whether ANY bucket carries a codec (the
+    # compression.encode failpoint gate)
+    residual_specs: tuple = ()
+    has_codec: bool = False
 
 
 class StepReplay:
@@ -302,9 +309,11 @@ class StepReplay:
     def invalidate_all(self, reason: str):
         """Drop every armed stream and recorded streak (join(), elastic
         world-version bumps, explicit resets). Held ZeRO-1 prefetch legs
-        ride the same invalidation edge — a leg must never outlive the
-        world it was gathered for (invalidate, not poison)."""
+        and error-feedback residual buffers ride the same invalidation
+        edge — neither must outlive the world it was computed for
+        (invalidate, not poison)."""
         self.engine.invalidate_prefetch(reason)
+        self.engine.invalidate_residuals(reason)
         had_armed = any(e.get("armed") for e in self._seen.values())
         self._seen.clear()
         if self._mode in ("replay", "drain"):
@@ -472,7 +481,8 @@ class StepReplay:
             key = (cls, sig.code, sig.pre, sig.post) + tuple(sig.extra)
             if cls == "sharded" or not segs or segs[-1]["key"] != key:
                 segs.append({"key": key, "cls": cls, "shapes": [],
-                             "dtypes": [], "extra": sig.extra})
+                             "dtypes": [], "extra": sig.extra,
+                             "name": sig.name})
             segs[-1]["shapes"].extend(sig.shapes)
             segs[-1]["dtypes"].extend(sig.dtypes)
         join_metas = None
@@ -489,6 +499,14 @@ class StepReplay:
             op_code = segs[0]["key"][1]
             adv_shapes = segs[0]["shapes"]
             adv_dtypes = segs[0]["dtypes"]
+            if segs[0]["cls"] == "reduce":
+                # the advertised op field packs the call codec (the
+                # engine's submission-site convention) so a joined peer's
+                # substitute resolves the same compressed program
+                adv_codec = (segs[0]["extra"][0] if segs[0]["extra"]
+                             else _comp.CODEC_NONE)
+                op_code = int(op_code) | (
+                    _comp.CODECS.index(adv_codec) << 4)
             if segs[0]["cls"] == "sharded":
                 join_kind = "sharded_step"
                 n_grads = segs[0]["extra"][1]
@@ -505,14 +523,18 @@ class StepReplay:
             join_metas = rows
         hier_local = self._hier_local()
         topo_local = eng.topology.local_size
+        world = eng.backend.size()
         built = []
         seg_dtypes = []
+        seg_res = []       # per built segment: per-bucket residual spec
         nbytes = 0
         link_total: Dict[str, int] = {}
 
-        def _note_links(algo: str, b: int, kind: str = "allreduce"):
-            for link, v in _C.link_split(algo, b, topo_local,
-                                         kind=kind).items():
+        def _note_links(algo: str, b: int, kind: str = "allreduce",
+                        codec: str = _comp.CODEC_NONE, itemsize: int = 4):
+            for link, v in _C.link_split(algo, b, topo_local, kind=kind,
+                                         codec=codec,
+                                         itemsize=itemsize).items():
                 link_total[link] = link_total.get(link, 0) + v
 
         for seg in segs:
@@ -523,7 +545,9 @@ class StepReplay:
                 # in the sig's extra) — never re-derived from the live
                 # fusion threshold, which may have moved since the sharded
                 # state was initialized (shard shapes are pinned to it)
-                _, op_code, pre, post, update_key, n_grads, bkey = seg["key"]
+                key = seg["key"]
+                _, op_code, pre, post, update_key, n_grads, bkey = key[:7]
+                call_codec = key[7] if len(key) > 7 else _comp.CODEC_NONE
                 proxies = [_LeafProxy(s, d)
                            for s, d in zip(seg["shapes"][:n_grads],
                                            seg["dtypes"][:n_grads])]
@@ -535,41 +559,94 @@ class StepReplay:
                     eng._choose_algo("allgather",
                                      sum(proxies[i].nbytes for i in b))
                     for b in bkey)
-                for algo, b in zip(ag_algos, bkey):
-                    bb = sum(proxies[i].nbytes for i in b)
-                    _note_links("flat", bb)                    # rs leg
-                    _note_links(algo, bb, kind="allgather")    # ag leg
+                # rs-leg codec resolution mirrors engine.sharded_step
+                rs_codecs = eng._bucket_codecs("reducescatter", proxies,
+                                               bkey, call_codec,
+                                               count=False)
+                res_specs = []
+                for b, (idxs, c) in enumerate(zip(bkey, rs_codecs)):
+                    bb = sum(proxies[i].nbytes for i in idxs)
+                    it = proxies[idxs[0]].dtype.itemsize
+                    _note_links("flat", bb, kind="reducescatter",
+                                codec=c, itemsize=it)          # rs leg
+                    _note_links(ag_algos[b], bb, kind="allgather")  # ag
+                    if c in _comp.EF_CODECS:
+                        total = sum(
+                            int(np.prod(proxies[i].shape))
+                            if proxies[i].shape else 1 for i in idxs)
+                        elems = _C.codec_residual_elems(
+                            "sharded", total, world, 0, None, c)
+                        res_specs.append((("zrs", update_key, b, c,
+                                           elems), elems,
+                                          str(proxies[idxs[0]].dtype)))
+                    else:
+                        res_specs.append(None)
+                seg_res.append(tuple(res_specs))
                 built.append(("sharded", (op_code, update_key, n_grads),
-                              pre, post, (topo_local, ag_algos),
+                              pre, post, (topo_local, ag_algos,
+                                          rs_codecs),
                               tuple(seg["shapes"]), bkey))
                 continue
-            _, code, pre, post = seg["key"]
+            key = seg["key"]
+            _, code, pre, post = key[:4]
+            call_codec = (key[4] if cls == "reduce" and len(key) > 4
+                          else _comp.CODEC_NONE)
             proxies = [_LeafProxy(s, d)
                        for s, d in zip(seg["shapes"], seg["dtypes"])]
             nbytes += sum(p.nbytes for p in proxies)
             buckets = bucket_by_size(proxies, cfg.fusion_threshold_bytes)
             if cls == "reduce":
-                # per-bucket topology-aware lowering (ISSUE 10), resolved
-                # through the same engine selection the warmup path used
+                # per-bucket topology-aware lowering (ISSUE 10) + wire
+                # codec (ISSUE 13), resolved through the same engine
+                # selection the warmup path used — armed and eager
+                # programs (and residual lineages) agree
                 algos = tuple(
                     eng._choose_algo("allreduce",
                                      sum(proxies[i].nbytes for i in b))
                     for b in buckets)
-                for algo, b in zip(algos, buckets):
-                    _note_links(algo, sum(proxies[i].nbytes for i in b))
-                topo_field = (topo_local, algos)
+                codecs = eng._bucket_codecs("grouped_allreduce", proxies,
+                                            buckets, call_codec,
+                                            count=False)
+                res_specs = []
+                for b, (idxs, algo, c) in enumerate(zip(buckets, algos,
+                                                        codecs)):
+                    bb = sum(proxies[i].nbytes for i in idxs)
+                    it = proxies[idxs[0]].dtype.itemsize
+                    _note_links(algo, bb, codec=c, itemsize=it)
+                    if c in _comp.EF_CODECS:
+                        total = sum(
+                            int(np.prod(proxies[i].shape))
+                            if proxies[i].shape else 1 for i in idxs)
+                        elems = _C.codec_residual_elems(
+                            "reduce", total, world, topo_local, algo, c)
+                        rkey = eng._residual_key(
+                            "gar", seg["name"], b, algo, c, elems,
+                            str(proxies[idxs[0]].dtype))
+                        res_specs.append((rkey, elems,
+                                          str(proxies[idxs[0]].dtype)))
+                    else:
+                        res_specs.append(None)
+                seg_res.append(tuple(res_specs))
+                topo_field = (topo_local, algos, codecs)
             else:
                 for b in buckets:
                     _note_links("flat", sum(proxies[i].nbytes for i in b))
+                seg_res.append((None,) * len(buckets))
                 topo_field = 0
             built.append((cls, code, pre, post, topo_field,
                           tuple(seg["shapes"]),
                           tuple(tuple(b) for b in buckets)))
         n_buckets = sum(len(seg[6]) for seg in built)
         has_sharded = any(seg[0] == "sharded" for seg in built)
+        has_codec = any(
+            isinstance(seg[4], tuple) and len(seg[4]) > 2
+            and any(c != _comp.CODEC_NONE for c in seg[4][2])
+            for seg in built)
+        residual_specs = tuple(spec for specs in seg_res
+                               for spec in specs if spec is not None)
         mode = self._overlap_mode(nbytes, n_buckets, has_sharded)
         prefetch = bool(cfg.zero1_prefetch)
-        stages = (self._stage_plan(built, seg_dtypes, prefetch)
+        stages = (self._stage_plan(built, seg_dtypes, prefetch, seg_res)
                   if mode == "staged" else ())
         algo_sig = self._algo_sig()
         return _Armed(stream, tuple(built),
@@ -578,11 +655,13 @@ class StepReplay:
                        tuple(seg[4] for seg in built)),
                       nbytes, cfg.fusion_threshold_bytes, hier_local,
                       join_metas, join_kind, mode, stages, n_buckets,
-                      has_sharded, prefetch, algo_sig, dict(link_total))
+                      has_sharded, prefetch, algo_sig, dict(link_total),
+                      residual_specs, has_codec)
 
     @staticmethod
     def _stage_plan(built: tuple, seg_dtypes: list,
-                    prefetch: bool = True) -> tuple:
+                    prefetch: bool = True,
+                    seg_res: Optional[list] = None) -> tuple:
         """Split the armed segment list into per-bucket sub-launches (the
         "staged" overlap mode): stage k's collective is already in flight
         while the host dispatches stage k+1's pack — dispatch-level
@@ -600,22 +679,32 @@ class StepReplay:
         - ``("zag", grad_shapes, grad_dtypes, buckets, out_idx,
           update_key, local_size, ag_algos)`` — the prefetch all-gather,
           consuming the previous zupd stage's shard outputs (per-bucket
-          flat/hierarchical selection riding along, ISSUE 10)."""
+          flat/hierarchical selection riding along, ISSUE 10).
+
+        Every "seg"/"zupd" stage tuple ends with ``res_specs`` — the
+        ``(engine residual key, elems, dtype)`` rows for that stage's
+        error-feedback buckets (ISSUE 13), in the stage program's
+        residual I/O order (empty when no codec is live)."""
         stages = []
         base = 0
-        for seg, dtypes in zip(built, seg_dtypes):
+        if seg_res is None:
+            seg_res = [(None,) * len(seg[6]) for seg in built]
+        for seg, dtypes, res_row in zip(built, seg_dtypes, seg_res):
             cls, code, pre, post, topo_field, shapes, buckets = seg
-            local, algos = _C._seg_algo_spec(topo_field, len(buckets))
+            local, algos, codecs = _C._seg_algo_spec(topo_field,
+                                                     len(buckets))
+            seg_specs = tuple(r for r in res_row if r is not None)
             if cls == "sharded" and not prefetch:
                 # prefetch disabled: one fused rs->update->ag sub-launch
                 io = tuple(range(base, base + len(shapes)))
-                stages.append(("seg", seg, io, io))
+                stages.append(("seg", seg, io, io, seg_specs))
             elif cls == "sharded":
                 op_code, update_key, n_grads = code
                 in_idx = tuple(range(base, base + len(shapes)))
                 state_out_idx = tuple(range(base + n_grads,
                                             base + len(shapes)))
-                stages.append(("zupd", seg, in_idx, state_out_idx))
+                stages.append(("zupd", seg, in_idx, state_out_idx,
+                               seg_specs))
                 stages.append(("zag", tuple(shapes[:n_grads]),
                                tuple(dtypes[:n_grads]), buckets,
                                tuple(range(base, base + n_grads)),
@@ -623,10 +712,13 @@ class StepReplay:
             else:
                 for bi, idxs in enumerate(buckets):
                     sub_shapes = tuple(shapes[i] for i in idxs)
-                    sub_seg = (cls, code, pre, post, (local, (algos[bi],)),
+                    sub_seg = (cls, code, pre, post,
+                               (local, (algos[bi],), (codecs[bi],)),
                                sub_shapes, (tuple(range(len(idxs))),))
                     io = tuple(base + i for i in idxs)
-                    stages.append(("seg", sub_seg, io, io))
+                    spec = res_row[bi]
+                    stages.append(("seg", sub_seg, io, io,
+                                   (spec,) if spec is not None else ()))
             base += len(shapes)
         return tuple(stages)
 
@@ -681,6 +773,9 @@ class StepReplay:
                                      link_bytes=armed.link_bytes)
         if eng.on_enqueue is not None:
             eng.on_enqueue(rep_name, "replay", armed.nbytes)
+        if armed.has_codec:
+            # same chaos seam as the eager compressed submission sites
+            engine_mod.failpoint("compression.encode")
         if armed.mode == "staged" and armed.stages:
             slot_garrs, slot_groups, group = self._launch_stages(
                 armed, flat, rep_name)
@@ -692,9 +787,13 @@ class StepReplay:
                                   armed.segments,
                                   sharded_updates=eng._sharded_updates,
                                   pipeline=(armed.mode != "off")))
+            res_args = [eng.backend.world_view(
+                eng._residual_fetch(k, e, dt))
+                for k, e, dt in armed.residual_specs]
             t0 = time.perf_counter()
             outs = engine_mod._translate_failure(
-                lambda: fn(*[eng.backend.world_view(t) for t in flat]))
+                lambda: fn(*([eng.backend.world_view(t) for t in flat]
+                             + res_args)))
             eng._count_dispatch()
             if eng.trace is not None:
                 eng.trace.record_dispatch(rep_name, "XLA_REPLAY_DISPATCH",
@@ -702,9 +801,11 @@ class StepReplay:
             if eng.on_activity is not None:
                 eng.on_activity(rep_name, "XLA_REPLAY_DISPATCH",
                                 (time.perf_counter() - t0) * 1e6)
+            for j, (k, _, _) in enumerate(armed.residual_specs):
+                eng._residual_store(k, outs[len(flat) + j])
             group = engine_mod.LaunchGroup(outs[-1])
-            slot_garrs = list(outs)
-            slot_groups = [group] * len(outs)
+            slot_garrs = list(outs[:len(flat)])
+            slot_groups = [group] * len(flat)
             n_launches = 1
         if armed.mode != "off":
             eng._m_overlap_steps.inc(mode=armed.mode)
@@ -751,23 +852,30 @@ class StepReplay:
             t0 = time.perf_counter()
             kind = st[0]
             if kind == "seg":
-                _, sub_seg, in_idx, out_idx = st
+                _, sub_seg, in_idx, out_idx, res_specs = st
                 fn = eng._builder(
                     ("replay_stage", sub_seg),
                     lambda: engine_mod.C.build_replay_step(
                         mesh, axis, (sub_seg,),
                         sharded_updates=eng._sharded_updates,
                         pipeline=True))
-                args = [eng.backend.world_view(flat[i]) for i in in_idx]
+                args = [eng.backend.world_view(flat[i]) for i in in_idx] \
+                    + [eng.backend.world_view(
+                        eng._residual_fetch(k, e, dt))
+                       for k, e, dt in res_specs]
                 outs = engine_mod._translate_failure(lambda: fn(*args))
                 group = engine_mod.LaunchGroup(outs[-1])
                 for pos, i in enumerate(out_idx):
                     slot_garrs[i] = outs[pos]
                     slot_groups[i] = group
+                for j, (k, _, _) in enumerate(res_specs):
+                    eng._residual_store(k, outs[len(out_idx) + j])
             elif kind == "zupd":
-                _, seg, in_idx, state_out_idx = st
-                _cls, code, pre, post, _local, shapes, buckets = seg
+                _, seg, in_idx, state_out_idx, res_specs = st
+                _cls, code, pre, post, topo_field, shapes, buckets = seg
                 op_code, update_key, n_grads = code
+                _local, _ag_algos, rs_codecs = engine_mod.C._seg_algo_spec(
+                    topo_field, len(buckets))
                 # registry read stays inside the builder factory so it
                 # happens at trace time only (the monolithic path's
                 # documented LRU contract: eviction after arming is
@@ -779,14 +887,21 @@ class StepReplay:
                         tuple(shapes[:n_grads]), None, buckets,
                         tuple(shapes[n_grads:]), None,
                         eng._sharded_updates[update_key], pre, post,
-                        packed=False))
-                args = [eng.backend.world_view(flat[i]) for i in in_idx]
+                        packed=False, codecs=rs_codecs))
+                args = [eng.backend.world_view(flat[i]) for i in in_idx] \
+                    + [eng.backend.world_view(
+                        eng._residual_fetch(k, e, dt))
+                       for k, e, dt in res_specs]
                 outs = engine_mod._translate_failure(lambda: fn(*args))
                 group = engine_mod.LaunchGroup(outs[-1])
                 held_shards = outs[:len(buckets)]
+                n_state = len(shapes) - n_grads
                 for pos, i in enumerate(state_out_idx):
                     slot_garrs[i] = outs[len(buckets) + pos]
                     slot_groups[i] = group
+                for j, (k, _, _) in enumerate(res_specs):
+                    eng._residual_store(
+                        k, outs[len(buckets) + n_state + j])
             else:  # "zag": the prefetch leg, consuming the zupd shards
                 (_, gshapes, gdtypes, buckets, out_idx, update_key,
                  ag_local, ag_algos) = st

@@ -205,6 +205,12 @@ class GlobalState:
             # deterministic in (bytes, topology, knobs) so every rank
             # flips identically at sample boundaries.
             categorical += ["collective_algo"]
+            # link-aware gradient compression (ISSUE 13): env-resolved
+            # codec vs none — offered ONLY when the user enabled a codec
+            # (autotune must never silently turn lossy compression on;
+            # the codec-vs-wire-time trade is what it explores)
+            if cfg.compression != "none":
+                categorical += ["compression"]
             self.parameter_manager = ParameterManager(
                 warmup_samples=cfg.autotune_warmup_samples,
                 steps_per_sample=cfg.autotune_steps_per_sample,
@@ -228,6 +234,7 @@ class GlobalState:
                     "shard_optimizer": cfg.shard_optimizer,
                     "overlap_pipeline": cfg.overlap_pipeline != "off",
                     "collective_algo": cfg.collective_algo != "flat",
+                    "compression": cfg.compression != "none",
                 })
             self.engine.parameter_manager = self.parameter_manager
 

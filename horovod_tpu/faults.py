@@ -90,6 +90,14 @@ FAULT_SPECS: Dict[str, str] = {
                        "stopped contributing mid-step",
     "engine.complete": "At the top of Handle.synchronize, before the "
                        "completion wait — the user-visible completion edge",
+    "compression.encode": "Before a compressed collective is dispatched "
+                          "(eager grouped/single allreduce, the sharded "
+                          "step's rs legs, and armed replay launches "
+                          "when any bucket carries a wire codec): "
+                          "raise() models an encode failure — it must "
+                          "surface as HorovodInternalError for the "
+                          "elastic loop, with residual buffers "
+                          "invalidated (never poisoned) on the restore",
     "overlap.prefetch": "Before the ZeRO-1 parameter all-gather prefetch "
                         "leg is launched under the step tail (ISSUE 6): "
                         "raise() models a prefetch launch failure — it "

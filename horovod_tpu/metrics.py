@@ -67,6 +67,19 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
         "counter", "Topology-aware algorithm selections, one per fusion "
                    "bucket, by op kind and algorithm "
                    "(flat/tree/hierarchical)"),
+    "hvd_tpu_compression_codec_total": (
+        "counter", "Wire-codec selections, one per fusion bucket, by op "
+                   "kind and resolved codec (none/bf16/fp8/int8 — "
+                   "non-float buckets resolve to none)"),
+    "hvd_tpu_compression_bytes_saved_total": (
+        "counter", "Wire bytes removed by the gradient codecs, by fabric "
+                   "link (the encoded legs' uncompressed-minus-encoded "
+                   "delta; hvd_tpu_wire_bytes_total already counts the "
+                   "encoded sizes)"),
+    "hvd_tpu_compression_residual_invalidations_total": (
+        "counter", "Error-feedback residual buffers dropped before reuse "
+                   "(join(), elastic world-version bumps, explicit "
+                   "resets — the prefetch-leg invalidation contract)"),
     "hvd_tpu_collectives_total": (
         "counter", "Collective operations submitted, by op kind"),
     "hvd_tpu_fusion_buckets_total": (

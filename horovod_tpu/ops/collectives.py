@@ -37,6 +37,7 @@ from jax import shard_map
 
 from ..common.env import DEFAULT_TREE_THRESHOLD_BYTES
 from ..common.reduce_ops import ReduceOp
+from . import compression as comp
 
 logger = logging.getLogger("horovod_tpu")
 
@@ -154,7 +155,8 @@ def choose_algorithm(kind: str, nbytes: int, topology,
 
 
 def link_split(algo: str, nbytes: int, local_size: int,
-               kind: str = "allreduce") -> dict:
+               kind: str = "allreduce", codec: str = comp.CODEC_NONE,
+               itemsize: int = 4) -> dict:
     """Per-fabric attribution of one bucket's payload bytes (the
     ``link`` label on ``hvd_tpu_wire_bytes_total``): each byte is counted
     once, attributed to the fabric that paces it.
@@ -166,13 +168,44 @@ def link_split(algo: str, nbytes: int, local_size: int,
       blocks — EVERY payload byte crosses DCN (the win there is one
       contiguous block transfer instead of a whole-world ring, not a
       byte reduction), so the full payload is attributed to DCN;
-    - every other lowering is whole-fabric ("flat")."""
+    - every other lowering is whole-fabric ("flat").
+
+    ``codec`` (ISSUE 13) shrinks the *encoded* leg: on the hierarchical
+    ladder only the DCN exchange is encoded — the ICI legs stay full
+    precision, so their bytes are unchanged. Flat/tree allreduce
+    lowerings run the compressed-RS + full-precision-AG fallback, so
+    HALF the payload movement is encoded; a reduce-scatter is all
+    encoded. ``itemsize`` is the uncompressed element size the codec
+    ratio is computed against.
+
+    Convention note: this is SUBMITTED-payload accounting, not
+    algorithmic link traffic — the uncompressed ladder's cross RS+AG is
+    likewise booked at dcn_raw though it moves ~2x that, and the encoded
+    cross gather's receive volume grows with the slice count C (each
+    peer's encoded shard arrives once). Before/after deltas under one
+    convention stay comparable; the realized wall-clock win on the
+    gather form shrinks as C approaches the compression ratio
+    (docs/compression.md)."""
+    nbytes = int(nbytes)
+
+    def enc(b):
+        if codec == comp.CODEC_NONE:
+            return b
+        return (b // itemsize) * comp.wire_itemsize(codec, itemsize)
+
     if algo == ALGO_HIERARCHICAL and local_size > 1:
         if kind == "allgather":
-            return {"dcn": int(nbytes)}
-        dcn = int(nbytes) // local_size
-        return {"dcn": dcn, "ici": int(nbytes) - dcn}
-    return {"flat": int(nbytes)}
+            return {"dcn": nbytes}
+        dcn_raw = nbytes // local_size
+        return {"dcn": enc(dcn_raw), "ici": nbytes - dcn_raw}
+    if kind == "allgather":
+        return {"flat": nbytes}
+    if kind == "reducescatter":
+        return {"flat": enc(nbytes)}
+    # allreduce family: the encoded reduce-scatter half + the
+    # full-precision all-gather half of the payload convention
+    half = nbytes // 2
+    return {"flat": enc(half) + (nbytes - half)}
 
 
 def slice_groups(n: int, local_size: int):
@@ -206,6 +239,154 @@ def tree_groups(n: int) -> List[List[List[int]]]:
         rounds.append([[r, r | k] for r in range(n) if not (r & k)])
         k <<= 1
     return rounds
+
+
+# ---------------------------------------------------------------------------
+# Link-aware wire codecs (ISSUE 13)
+#
+# A quantized payload cannot be summed on the wire (int8 sums overflow and
+# per-sender scales differ), so every compressed reduction decodes before
+# accumulating (in float32), in one of two shapes:
+#
+# - the hierarchical ladder keeps its ICI reduce-scatter/all-gather legs
+#   full precision and replaces ONLY the cross-slice (DCN) exchange with a
+#   gather of encoded shards + rank-local decode-sum — compression error
+#   scales with the slow link's traffic, and with the slice count C
+#   typically at or under the compression ratio, the (C-1)-fold encoded
+#   gather still undercuts the full-precision cross RS+AG;
+# - flat/tree selections take the whole-payload fallback: a compressed
+#   reduce-scatter (all-to-all of encoded chunks, decode-sum of the owned
+#   chunk) followed by a full-precision all-gather — enc + nbytes on the
+#   wire vs the ring's ~2*nbytes, a win at EVERY world size (a
+#   whole-payload gather's receive traffic would grow with n instead).
+#
+# Either way the result is identical on every member of the exchange
+# group (same received data, same arithmetic), i.e. replicated by
+# construction. The error-feedback codecs quantize (g + residual) and
+# carry the quantization error forward in a rank-local residual buffer
+# (engine state, per fusion bucket).
+# ---------------------------------------------------------------------------
+
+
+def codec_residual_elems(cls: str, total: int, n: int, local_size: int,
+                         algo: Optional[str], codec: str) -> Optional[int]:
+    """Residual-buffer length for one error-feedback bucket — the ONE
+    shape rule the engine, replay, and the builders share (a disagreement
+    would trace mis-shaped programs). ``cls`` is ``"reduce"`` (allreduce
+    family: the residual covers the encoded leg — the local-RS shard on
+    the hierarchical ladder, the whole payload otherwise) or
+    ``"sharded"`` (the ZeRO-1 reduce-scatter leg: the whole zero-padded
+    flat bucket, since the scatter is whole-world). None = the codec
+    carries no residual."""
+    if codec not in comp.EF_CODECS:
+        return None
+    total = int(total)
+    if cls == "sharded":
+        return shard_spec(total, n)[0]
+    if algo == ALGO_HIERARCHICAL and local_size > 1:
+        pad = (-total) % local_size
+        return (total + pad) // local_size
+    # flat/tree fallback: the whole zero-padded payload (the compressed
+    # reduce-scatter's pre-scatter encode covers every element)
+    return shard_spec(total, n)[0]
+
+
+def _gathered_decode_sum(payload, scale, axis: str, groups, codec: str,
+                         out_dtype):
+    """The compressed sum exchange: all-gather encoded contributions (and
+    their scales) over ``groups`` (None = the whole axis), decode, sum."""
+    g_pay = lax.all_gather(payload, axis, axis=0, tiled=False,
+                           axis_index_groups=groups)
+    g_scale = None
+    if scale is not None:
+        g_scale = lax.all_gather(scale, axis, axis=0, tiled=False,
+                                 axis_index_groups=groups)
+    return comp.decode_sum(g_pay, g_scale, codec, out_dtype)
+
+
+def _make_codec_reducer(axis: str, op: ReduceOp, n: int, local_size: int,
+                        algo: str, codec: str):
+    """Flat-buffer compressed-reduction closure: ``reduce(flat, residual)
+    -> (out, new_residual)``. ``algo`` must be pre-resolved; the
+    hierarchical form compresses only the cross-slice (DCN) exchange,
+    every other selection (flat, and tree — whose pair rounds would
+    compound quantization error) takes the whole-payload fallback: a
+    compressed reduce-scatter (:func:`_rs_flat_codec`) plus a
+    full-precision all-gather — enc + nbytes on the wire at every world
+    size, where a whole-payload gather would receive (n-1)*enc. Only
+    SUM/AVERAGE are compressible (the engine resolves other ops to codec
+    "none" before reaching here)."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(f"wire codecs support Sum and Average, got {op!r}")
+    hier = (algo == ALGO_HIERARCHICAL and 1 < local_size < n
+            and n % local_size == 0)
+    if hier:
+        local_groups, cross_groups = slice_groups(n, local_size)
+
+    def _reduce(flat, residual):
+        if hier:
+            pad = (-flat.shape[0]) % local_size
+            if pad:
+                flat = jnp.concatenate([flat,
+                                        jnp.zeros((pad,), flat.dtype)])
+            # ICI leg, full precision: intra-slice reduce-scatter
+            shard = lax.psum_scatter(flat, axis, scatter_dimension=0,
+                                     tiled=True,
+                                     axis_index_groups=local_groups)
+            # DCN leg, encoded: quantize(shard + residual), gather the
+            # cross-slice contributions, decode-sum
+            payload, scale, new_res = comp.ef_encode(shard, residual, codec)
+            ssum = _gathered_decode_sum(payload, scale, axis, cross_groups,
+                                        codec, shard.dtype)
+            # ICI leg, full precision: intra-slice all-gather back
+            out = lax.all_gather(ssum, axis, axis=0, tiled=True,
+                                 axis_index_groups=local_groups)
+            if pad:
+                out = out[:-pad]
+            if op == ReduceOp.AVERAGE:
+                out = out / n
+            return out, new_res
+        total = flat.shape[0]
+        shard, new_res = _rs_flat_codec(flat, residual, axis, n, op, codec)
+        out = lax.all_gather(shard, axis, axis=0, tiled=True)
+        if out.shape[0] != total:
+            out = out[:total]
+        return out, new_res
+
+    return _reduce
+
+
+def ef_allreduce_p(x, residual, axis_name: str, codec: str,
+                   op: ReduceOp = ReduceOp.SUM):
+    """Whole-payload compressed allreduce for traced (SPMD) code: the
+    in-shard_map sibling of the engine's codec path, used by
+    ``hvd.distributed(compression=Compression.int8)``. Same shape as the
+    flat fallback reducer — compressed reduce-scatter
+    (:func:`_rs_flat_codec`, error-feedback when ``residual`` is given)
+    plus a full-precision all-gather, so the wire cost is enc + nbytes
+    at every world size. ``residual`` rides in the caller's natural
+    shape; divisibility padding is handled here (padding positions
+    quantize exactly, so their residual is identically zero and safe to
+    trim). Returns ``(reduced, new_residual)`` (``new_residual`` is None
+    for non-EF codecs). The output is replicated by construction but not
+    VMA-inferrable — same caveat as the ladder builders."""
+    flat = x.reshape(-1)
+    total = flat.shape[0]
+    n = lax.psum(1, axis_name)   # constant-folds inside shard_map
+    padded, _ = shard_spec(total, n)
+    r = residual.reshape(-1) if residual is not None else None
+    if r is not None and padded != total:
+        r = jnp.concatenate([r, jnp.zeros((padded - total,), r.dtype)])
+    shard, new_r = _rs_flat_codec(flat, r, axis_name, n, op, codec)
+    out = lax.all_gather(shard, axis_name, axis=0, tiled=True)
+    if out.shape[0] != total:
+        out = out[:total]
+    out = out.reshape(x.shape)
+    if new_r is not None:
+        if new_r.shape[0] != total:
+            new_r = new_r[:total]
+        new_r = new_r.reshape(x.shape)
+    return out, new_r
 
 # ---------------------------------------------------------------------------
 # Layer 1: in-SPMD primitives (use inside shard_map / pjit-traced code)
@@ -635,18 +816,36 @@ def _resolved_bucket_algos(n: int, local_size: int, algos,
     return tuple(_resolve_reduce_algo(a, n, local_size) for a in algos)
 
 
+def _wrap_plain_reducer(fn):
+    """Lift a plain ``reduce(flat)`` closure onto the uniform codec-aware
+    signature ``reduce(flat, residual) -> (out, new_residual)``."""
+    def _reduce(flat, residual=None):
+        return fn(flat), None
+    return _reduce
+
+
 def _bucket_reducers(axis: str, op: ReduceOp, n: int, local_size: int,
-                     algos, n_buckets: int) -> list:
+                     algos, n_buckets: int, codecs=None) -> list:
     """One flat-buffer reduction closure per bucket, memoized per resolved
-    algorithm (buckets sharing an algorithm share the closure — and the
-    replica-group tables it captures)."""
+    (algorithm, codec) pair (buckets sharing both share the closure — and
+    the replica-group tables it captures). Every closure has the uniform
+    signature ``reduce(flat, residual) -> (out, new_residual)``; plain
+    (codec "none") reducers ignore the residual and return None for it."""
     resolved = _resolved_bucket_algos(n, local_size, algos, n_buckets)
+    if codecs is None:
+        codecs = (comp.CODEC_NONE,) * n_buckets
     cache: dict = {}
     out = []
-    for a in resolved:
-        if a not in cache:
-            cache[a] = _make_reduce_flat(axis, op, n, local_size, a)
-        out.append(cache[a])
+    for a, c in zip(resolved, codecs):
+        key = (a, c)
+        if key not in cache:
+            if c == comp.CODEC_NONE:
+                cache[key] = _wrap_plain_reducer(
+                    _make_reduce_flat(axis, op, n, local_size, a))
+            else:
+                cache[key] = _make_codec_reducer(axis, op, n, local_size,
+                                                 a, c)
+        out.append(cache[key])
     return out
 
 
@@ -655,7 +854,8 @@ def build_fused_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
                           prescale_factor: float = 1.0,
                           postscale_factor: float = 1.0,
                           local_size: int = 0,
-                          algo: Optional[str] = None):
+                          algo: Optional[str] = None,
+                          codec: str = comp.CODEC_NONE):
     """One-launch fused bucket allreduce: takes the stacked *packed* buffer
     (n, total) and returns one stacked (n, *shape_i) array per bucket member,
     reduced — pack→collective→unpack in a single jitted program (the whole
@@ -666,18 +866,22 @@ def build_fused_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
     NCCLHierarchicalAllreduce nccl_operations.cc:180-383) on the packed
     buffer; 0 = flat psum. ``algo`` (ISSUE 10) overrides that legacy
     rule with an explicit flat/tree/hierarchical choice from
-    :func:`choose_algorithm`.
+    :func:`choose_algorithm`. ``codec`` (ISSUE 13) encodes the slow leg
+    (error-feedback codecs append a residual input after the packed
+    buffer and a new-residual output after the pieces).
     """
     n = int(mesh.devices.size)
     sizes = [math.prod(s) for s in shapes]
-    _reduce_flat = _make_reduce_flat(axis, op, n, local_size, algo)
     resolved = _resolve_reduce_algo(algo, n, local_size)
+    (_reduce,) = _bucket_reducers(axis, op, n, local_size, (algo,), 1,
+                                  (codec,))
+    ef = codec in comp.EF_CODECS
 
-    def body(x):  # x block: (1, total)
+    def body(x, *res):  # x block: (1, total) [+ EF residual]
         flat = x[0]
         if prescale_factor != 1.0:
             flat = flat * prescale_factor
-        out = _reduce_flat(flat)
+        out, new_res = _reduce(flat, res[0] if ef else None)
         if postscale_factor != 1.0:
             out = out * postscale_factor
         pieces = []
@@ -686,11 +890,48 @@ def build_fused_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
             pieces.append(
                 lax.dynamic_slice_in_dim(out, offset, size).reshape(shape))
             offset += size
-        return tuple(pieces)
+        return tuple(pieces) + ((new_res,) if ef else ())
 
-    fn = _shmap(body, mesh, axis, in_specs=P(axis),
-                out_specs=tuple(P() for _ in shapes),
-                check_vma=(resolved == ALGO_FLAT))
+    fn = _shmap(body, mesh, axis,
+                in_specs=(P(axis),) + ((P(),) if ef else ()),
+                out_specs=tuple(P() for _ in shapes)
+                + ((P(),) if ef else ()),
+                check_vma=(resolved == ALGO_FLAT
+                           and codec == comp.CODEC_NONE))
+    return jax.jit(fn)
+
+
+def build_codec_allreduce(mesh: Mesh, axis: str, op: ReduceOp, shape,
+                          dtype, algo: str, codec: str,
+                          prescale_factor: float = 1.0,
+                          postscale_factor: float = 1.0,
+                          local_size: int = 0):
+    """Stacked single-tensor compressed allreduce (the eager
+    ``Engine.allreduce`` path when a wire codec is live): flatten, run
+    the codec reducer (hierarchical = DCN-leg encoded, otherwise whole
+    payload), reshape. Error-feedback codecs take the rank-local
+    residual as a second (world-view) input and return the new residual
+    after the reduced tensor."""
+    n = int(mesh.devices.size)
+    (_reduce,) = _bucket_reducers(axis, op, n, local_size, (algo,), 1,
+                                  (codec,))
+    ef = codec in comp.EF_CODECS
+
+    def body(x, *res):  # x block: (1, *s) [+ EF residual]
+        v = x[0]
+        flat = v.reshape(-1)
+        if prescale_factor != 1.0:
+            flat = flat * prescale_factor
+        out, new_res = _reduce(flat, res[0] if ef else None)
+        if postscale_factor != 1.0:
+            out = out * postscale_factor
+        out = out.reshape(v.shape)
+        return (out, new_res) if ef else out
+
+    fn = _shmap(body, mesh, axis,
+                in_specs=(P(axis),) + ((P(),) if ef else ()),
+                out_specs=(P(), P()) if ef else P(),
+                check_vma=False)
     return jax.jit(fn)
 
 
@@ -730,7 +971,8 @@ def build_grouped_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
                             postscale_factor: float = 1.0,
                             local_size: int = 0,
                             pipeline: bool = False,
-                            algos: Optional[Sequence[str]] = None):
+                            algos: Optional[Sequence[str]] = None,
+                            codecs: Optional[Sequence[str]] = None):
     """ONE launch for the whole grouped reduce+unpack: the per-bucket
     packed buffers (from :func:`build_pack_group`, stacked (n, total_b))
     go in, every reduced tensor of the group comes out — one collective
@@ -759,16 +1001,38 @@ def build_grouped_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
         rule for every bucket. The small latency-bound bucket of a step
         can lower to the tree form while its big bucket takes the
         hierarchical ladder, in the SAME program.
+      codecs: per-bucket wire codec ("none"/"bf16"/"fp8"/"int8", ISSUE
+        13); None = "none" everywhere. Error-feedback buckets grow the
+        program's I/O: one rank-local residual buffer per EF bucket is
+        appended AFTER the packed inputs (world-view lifted, the state-
+        leaf convention) and the matching new residuals come back after
+        the tensor outputs, in bucket order.
     """
     _check_bucket_dtypes(dtypes, buckets)
     n = int(mesh.devices.size)
+    if codecs is None:
+        codecs = (comp.CODEC_NONE,) * len(buckets)
+    codecs = tuple(codecs)
     reducers = _bucket_reducers(axis, op, n, local_size, algos,
-                                len(buckets))
+                                len(buckets), codecs)
     resolved = _resolved_bucket_algos(n, local_size, algos, len(buckets))
+    ef_buckets = tuple(b for b in range(len(buckets))
+                       if codecs[b] in comp.EF_CODECS)
     sizes = [math.prod(s) for s in shapes]
 
-    def body(*packed):  # per-bucket blocks (1, total_b)
+    def body(*args):  # per-bucket blocks (1, total_b) [+ EF residuals]
+        packed = args[:len(buckets)]
+        residuals = {b: args[len(buckets) + i]
+                     for i, b in enumerate(ef_buckets)}
         outs = [None] * len(shapes)
+        new_res: dict = {}
+
+        def _reduce(b, flat):
+            out, nr = reducers[b](flat, residuals.get(b))
+            if b in residuals:
+                new_res[b] = nr
+            return out
+
         if pipeline:
             flats = []
             for b in range(len(buckets)):
@@ -776,17 +1040,17 @@ def build_grouped_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
                 if prescale_factor != 1.0:
                     flat = flat * prescale_factor
                 flats.append(flat)
-            reds = [reducers[b](f) for b, f in enumerate(flats)]
+            reds = [_reduce(b, f) for b, f in enumerate(flats)]
             if postscale_factor != 1.0:
                 reds = [r * postscale_factor for r in reds]
             for b, idxs in enumerate(buckets):
                 _unpack_flat(reds[b], shapes, sizes, idxs, outs)
-            return tuple(outs)
+            return tuple(outs) + tuple(new_res[b] for b in ef_buckets)
         for b, idxs in enumerate(buckets):
             flat = packed[b][0]
             if prescale_factor != 1.0:
                 flat = flat * prescale_factor
-            red = reducers[b](flat)
+            red = _reduce(b, flat)
             if postscale_factor != 1.0:
                 red = red * postscale_factor
             offset = 0
@@ -794,12 +1058,16 @@ def build_grouped_allreduce(mesh: Mesh, axis: str, op: ReduceOp,
                 outs[i] = lax.dynamic_slice_in_dim(
                     red, offset, sizes[i]).reshape(shapes[i])
                 offset += sizes[i]
-        return tuple(outs)
+        return tuple(outs) + tuple(new_res[b] for b in ef_buckets)
 
     fn = _shmap(body, mesh, axis,
-                in_specs=tuple(P(axis) for _ in buckets),
-                out_specs=tuple(P() for _ in shapes),
-                check_vma=all(a == ALGO_FLAT for a in resolved))
+                in_specs=tuple(P(axis) for _ in buckets)
+                + tuple(P() for _ in ef_buckets),
+                out_specs=tuple(P() for _ in shapes)
+                + tuple(P() for _ in ef_buckets),
+                check_vma=(all(a == ALGO_FLAT for a in resolved)
+                           and not any(c != comp.CODEC_NONE
+                                       for c in codecs)))
     return jax.jit(fn)
 
 
@@ -867,6 +1135,41 @@ def _rs_flat(flat, axis: str, n: int, op: ReduceOp):
     if op == ReduceOp.AVERAGE:
         shard = shard / n
     return shard
+
+
+def _rs_flat_codec(flat, residual, axis: str, n: int, op: ReduceOp,
+                   codec: str):
+    """Compressed flat reduce-scatter (the ZeRO-1 gradient leg, ISSUE 13):
+    the codec is applied PRE-scatter — each rank encodes its whole padded
+    contribution (error-feedback: quantize(flat + residual)) — and the
+    exchange is an all-to-all of encoded chunks: rank r still receives
+    exactly chunk r of every peer's buffer, so the shard-ownership
+    invariant (:func:`shard_spec`: rank r owns contiguous chunk r) is
+    untouched; the received contributions are decoded rank-locally with
+    their senders' scales and summed in float32. Same shard out as
+    :func:`_rs_flat`, 1/ratio of the wire bytes. Returns ``(shard,
+    new_residual)``."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"reducescatter supports Sum and Average, got {op!r}")
+    padded, shard_len = shard_spec(flat.shape[0], n)
+    pad = padded - flat.shape[0]
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    payload, scale, new_res = comp.ef_encode(flat, residual, codec)
+    chunks = payload.reshape(n, shard_len)
+    # row j of the result is rank j's chunk for THIS rank (alltoall_p's
+    # split/concat convention) — chunk ownership is positional, exactly
+    # the flat ring's
+    recv = lax.all_to_all(chunks, axis, split_axis=0, concat_axis=0,
+                          tiled=False)
+    scales = None
+    if scale is not None:
+        scales = lax.all_gather(scale, axis, axis=0, tiled=False)
+    shard = comp.decode_sum(recv, scales, codec, flat.dtype)
+    if op == ReduceOp.AVERAGE:
+        shard = shard / n
+    return shard, new_res
 
 
 def _ag_flat(shard, axis: str, total: int, algo: str = ALGO_FLAT,
@@ -1031,7 +1334,8 @@ def build_sharded_update(mesh: Mesh, axis: str, op: ReduceOp,
                          state_shapes, state_dtypes, update,
                          prescale_factor: float = 1.0,
                          postscale_factor: float = 1.0,
-                         packed: bool = True):
+                         packed: bool = True,
+                         codecs: Optional[Sequence[str]] = None):
     """The FIRST pipeline stage of a split ZeRO-1 step (ISSUE 6 prefetch):
     reduce-scatter every gradient bucket (issued back-to-back, no unpack
     interposing) and run ``update`` on this rank's shards — but do NOT
@@ -1047,14 +1351,27 @@ def build_sharded_update(mesh: Mesh, axis: str, op: ReduceOp,
     from :func:`build_pack_group` (engine path). ``packed=False``: inputs
     are the raw gradient tensors in natural shapes presented as world
     views (the staged replay path — same input convention as
-    :func:`build_replay_step`)."""
+    :func:`build_replay_step`).
+
+    ``codecs`` (ISSUE 13) compresses the reduce-scatter legs per bucket
+    (:func:`_rs_flat_codec` — pre-scatter encode, rank-local decode,
+    shard ownership untouched). Error-feedback buckets append a residual
+    input after the state leaves and a new-residual output after the new
+    state, in bucket order."""
     if dtypes is not None:
         _check_bucket_dtypes(dtypes, buckets)
     n = int(mesh.devices.size)
+    if codecs is None:
+        codecs = (comp.CODEC_NONE,) * len(buckets)
+    codecs = tuple(codecs)
+    ef_buckets = tuple(b for b in range(len(buckets))
+                       if codecs[b] in comp.EF_CODECS)
 
     def body(*args):
         n_in = len(buckets) if packed else len(shapes)
-        state = list(args[n_in:])
+        state = list(args[n_in:n_in + len(state_shapes)])
+        residuals = {b: args[n_in + len(state_shapes) + i]
+                     for i, b in enumerate(ef_buckets)}
         flats = []
         for b, idxs in enumerate(buckets):
             if packed:
@@ -1066,20 +1383,33 @@ def build_sharded_update(mesh: Mesh, axis: str, op: ReduceOp,
             flats.append(flat)
         # collectives issued back-to-back: mutually independent, the
         # overlap-ready form
-        shards = [_rs_flat(f, axis, n, op) for f in flats]
+        shards = []
+        new_res: dict = {}
+        for b, f in enumerate(flats):
+            if codecs[b] == comp.CODEC_NONE:
+                shards.append(_rs_flat(f, axis, n, op))
+            else:
+                s, nr = _rs_flat_codec(f, residuals.get(b), axis, n, op,
+                                       codecs[b])
+                if b in residuals:
+                    new_res[b] = nr
+                shards.append(s)
         if postscale_factor != 1.0:
             shards = [s * postscale_factor for s in shards]
         new_shards, new_state = update(shards, state)
         _check_state_leaves(state, new_state)
-        return tuple(s[None] for s in new_shards) + tuple(new_state)
+        return tuple(s[None] for s in new_shards) + tuple(new_state) \
+            + tuple(new_res[b] for b in ef_buckets)
 
     n_in = len(buckets) if packed else len(shapes)
     in_specs = (tuple(P(axis) for _ in buckets) if packed
                 else tuple(P() for _ in shapes))
     fn = _shmap(body, mesh, axis,
-                in_specs=in_specs + tuple(P() for _ in state_shapes),
+                in_specs=in_specs + tuple(P() for _ in state_shapes)
+                + tuple(P() for _ in ef_buckets),
                 out_specs=tuple(P(axis) for _ in buckets)
-                + tuple(P() for _ in state_shapes),
+                + tuple(P() for _ in state_shapes)
+                + tuple(P() for _ in ef_buckets),
                 check_vma=False)
     return jax.jit(fn)
 
@@ -1091,7 +1421,8 @@ def build_sharded_step(mesh: Mesh, axis: str, op: ReduceOp,
                        postscale_factor: float = 1.0,
                        pipeline: bool = False,
                        local_size: int = 0,
-                       ag_algos: Optional[Sequence[str]] = None):
+                       ag_algos: Optional[Sequence[str]] = None,
+                       codecs: Optional[Sequence[str]] = None):
     """ONE launch for a whole ZeRO-1 optimizer step: per-bucket packed
     gradient buffers (stacked (n, total_b)) plus this rank's optimizer-state
     leaves (world-view lifted, genuinely different per rank) go in; the
@@ -1114,6 +1445,13 @@ def build_sharded_step(mesh: Mesh, axis: str, op: ReduceOp,
     ``ag_algos`` selects flat vs hierarchical for the return all-gather
     per bucket (ISSUE 10); the reduce-scatter leg is always the flat
     ring (shard-ownership invariant, :func:`validate_algorithm`).
+
+    ``codecs`` (ISSUE 13) compresses the GRADIENT reduce-scatter legs
+    per bucket (pre-scatter encode, rank-local decode — ownership
+    untouched, :func:`_rs_flat_codec`); the parameter all-gather stays
+    full precision (every rank must reconstruct bit-identical params).
+    Error-feedback buckets append a residual input after the state
+    leaves and a new-residual output after the new state.
     """
     _check_bucket_dtypes(dtypes, buckets)
     n = int(mesh.devices.size)
@@ -1121,12 +1459,30 @@ def build_sharded_step(mesh: Mesh, axis: str, op: ReduceOp,
         ag_algos = (ALGO_FLAT,) * len(buckets)
     ag_algos = tuple(validate_algorithm("allgather", a, n, local_size)
                      for a in ag_algos)
+    if codecs is None:
+        codecs = (comp.CODEC_NONE,) * len(buckets)
+    codecs = tuple(codecs)
+    ef_buckets = tuple(b for b in range(len(buckets))
+                       if codecs[b] in comp.EF_CODECS)
     sizes = [math.prod(s) for s in shapes]
     totals = [sum(sizes[i] for i in idxs) for idxs in buckets]
 
     def body(*args):
         packed = args[:len(buckets)]
-        state = list(args[len(buckets):])
+        state = list(args[len(buckets):len(buckets) + len(state_shapes)])
+        residuals = {b: args[len(buckets) + len(state_shapes) + i]
+                     for i, b in enumerate(ef_buckets)}
+        new_res: dict = {}
+
+        def _rs(b, flat):
+            if codecs[b] == comp.CODEC_NONE:
+                return _rs_flat(flat, axis, n, op)
+            s, nr = _rs_flat_codec(flat, residuals.get(b), axis, n, op,
+                                   codecs[b])
+            if b in residuals:
+                new_res[b] = nr
+            return s
+
         if pipeline:
             flats = []
             for b in range(len(buckets)):
@@ -1134,7 +1490,7 @@ def build_sharded_step(mesh: Mesh, axis: str, op: ReduceOp,
                 if prescale_factor != 1.0:
                     flat = flat * prescale_factor
                 flats.append(flat)
-            shards = [_rs_flat(f, axis, n, op) for f in flats]
+            shards = [_rs(b, f) for b, f in enumerate(flats)]
             if postscale_factor != 1.0:
                 shards = [s * postscale_factor for s in shards]
         else:
@@ -1143,7 +1499,7 @@ def build_sharded_step(mesh: Mesh, axis: str, op: ReduceOp,
                 flat = packed[b][0]
                 if prescale_factor != 1.0:
                     flat = flat * prescale_factor
-                shard = _rs_flat(flat, axis, n, op)
+                shard = _rs(b, flat)
                 if postscale_factor != 1.0:
                     shard = shard * postscale_factor
                 shards.append(shard)
@@ -1161,7 +1517,8 @@ def build_sharded_step(mesh: Mesh, axis: str, op: ReduceOp,
                 full = _ag_flat(new_shards[b], axis, totals[b],
                                 ag_algos[b], n, local_size)
                 _unpack_flat(full, shapes, sizes, idxs, outs)
-        return tuple(outs) + tuple(new_state)
+        return tuple(outs) + tuple(new_state) \
+            + tuple(new_res[b] for b in ef_buckets)
 
     # packed grads arrive stacked; state leaves are world-view claims (each
     # rank's own shard presented as 'replicated'); gathered params are
@@ -1169,9 +1526,11 @@ def build_sharded_step(mesh: Mesh, axis: str, op: ReduceOp,
     # VMA-inferrable, same as the replay builder
     fn = _shmap(body, mesh, axis,
                 in_specs=tuple(P(axis) for _ in buckets)
-                + tuple(P() for _ in state_shapes),
+                + tuple(P() for _ in state_shapes)
+                + tuple(P() for _ in ef_buckets),
                 out_specs=tuple(P() for _ in shapes)
-                + tuple(P() for _ in state_shapes),
+                + tuple(P() for _ in state_shapes)
+                + tuple(P() for _ in ef_buckets),
                 check_vma=False)
     return jax.jit(fn)
 
@@ -1180,18 +1539,56 @@ def _seg_algo_spec(field, n_buckets: int):
     """Decode a replay segment's topology field (position 4): a bare int
     is the legacy form — ``local_size``, > 1 meaning hierarchical for
     every bucket — while a ``(local_size, algos)`` tuple carries the
-    per-bucket topology-aware selection (ISSUE 10). For "sharded"
-    segments the algo list applies to the return all-gather legs (the
-    reduce-scatter is pinned flat)."""
+    per-bucket topology-aware selection (ISSUE 10) and a
+    ``(local_size, algos, codecs)`` tuple additionally carries the
+    per-bucket wire codec (ISSUE 13; both shorter forms mean codec
+    "none" everywhere). For "sharded" segments the algo list applies to
+    the return all-gather legs (the reduce-scatter is pinned flat) and
+    the codec list to the reduce-scatter legs."""
     if isinstance(field, tuple):
         local, algos = int(field[0]), tuple(field[1])
         if len(algos) != n_buckets:
             raise ValueError(
                 f"segment algo list has {len(algos)} entries for "
                 f"{n_buckets} buckets")
+        codecs = (tuple(field[2]) if len(field) > 2
+                  else (comp.CODEC_NONE,) * n_buckets)
+        if len(codecs) != n_buckets:
+            raise ValueError(
+                f"segment codec list has {len(codecs)} entries for "
+                f"{n_buckets} buckets")
     else:
         local, algos = int(field), (None,) * n_buckets
-    return local, algos
+        codecs = (comp.CODEC_NONE,) * n_buckets
+    return local, algos, codecs
+
+
+def replay_residual_layout(segments, n: int) -> list:
+    """Error-feedback residual I/O order for a replay program: one entry
+    ``(seg_idx, bucket_idx, elems)`` per EF-codec bucket, in
+    segment-major bucket-minor program order. Residual inputs follow the
+    step's tensors in this order and the new-residual outputs follow the
+    tensor outputs the same way — the engine's replay launch and
+    :func:`build_replay_step` both derive the arity from here."""
+    out = []
+    for si, seg in enumerate(segments):
+        cls, code, pre, post, topo_field, shapes, buckets = seg
+        local, algos, codecs = _seg_algo_spec(topo_field, len(buckets))
+        sizes = [math.prod(s) for s in shapes]
+        for bi, idxs in enumerate(buckets):
+            codec = codecs[bi]
+            if codec not in comp.EF_CODECS:
+                continue
+            total = sum(sizes[i] for i in idxs)
+            if cls == "sharded":
+                elems = codec_residual_elems("sharded", total, n, local,
+                                             None, codec)
+            else:
+                algo = _resolve_reduce_algo(algos[bi], n, local)
+                elems = codec_residual_elems("reduce", total, n, local,
+                                             algo, codec)
+            out.append((si, bi, elems))
+    return out
 
 
 def build_replay_step(mesh: Mesh, axis: str, segments,
@@ -1239,9 +1636,17 @@ def build_replay_step(mesh: Mesh, axis: str, segments,
     """
     n = int(mesh.devices.size)
     n_tensors = sum(len(seg[5]) for seg in segments)
+    # error-feedback residual I/O (ISSUE 13): one rank-local residual per
+    # EF-codec bucket rides after the step's tensors (world-view lifted)
+    # and the new residuals return after the tensor outputs, in
+    # replay_residual_layout order
+    res_layout = replay_residual_layout(segments, n)
+    res_in = {(si, bi): n_tensors + k
+              for k, (si, bi, _) in enumerate(res_layout)}
 
     def body_pipelined(*ts):
         outs = [None] * n_tensors
+        new_res: dict = {}
         bases = []
         base = 0
         for seg in segments:
@@ -1261,18 +1666,31 @@ def build_replay_step(mesh: Mesh, axis: str, segments,
         reds = {}    # (seg_idx, bucket_idx) -> reduced flat / shard
         for si, (cls, code, pre, post, topo_field, shapes,
                  buckets) in enumerate(segments):
-            local_size, algos = _seg_algo_spec(topo_field, len(buckets))
+            local_size, algos, codecs = _seg_algo_spec(topo_field,
+                                                       len(buckets))
             if cls == "reduce":
                 reducers = _bucket_reducers(axis, ReduceOp(code), n,
                                             local_size, algos,
-                                            len(buckets))
+                                            len(buckets), codecs)
             for bi in range(len(buckets)):
                 flat = packs[(si, bi)]
+                res = ts[res_in[(si, bi)]] if (si, bi) in res_in else None
                 if cls == "sharded":
-                    reds[(si, bi)] = _rs_flat(flat, axis, n,
-                                              ReduceOp(code[0]))
+                    if codecs[bi] == comp.CODEC_NONE:
+                        reds[(si, bi)] = _rs_flat(flat, axis, n,
+                                                  ReduceOp(code[0]))
+                    else:
+                        shard, nr = _rs_flat_codec(flat, res, axis, n,
+                                                   ReduceOp(code[0]),
+                                                   codecs[bi])
+                        if (si, bi) in res_in:
+                            new_res[(si, bi)] = nr
+                        reds[(si, bi)] = shard
                 elif cls == "reduce":
-                    reds[(si, bi)] = reducers[bi](flat)
+                    red, nr = reducers[bi](flat, res)
+                    if (si, bi) in res_in:
+                        new_res[(si, bi)] = nr
+                    reds[(si, bi)] = red
                 else:
                     reds[(si, bi)] = broadcast_p(flat, axis, code)
         # -- phase 3: shard-local updates + return all-gathers --
@@ -1280,8 +1698,8 @@ def build_replay_step(mesh: Mesh, axis: str, segments,
                  buckets) in enumerate(segments):
             sizes = [math.prod(s) for s in shapes]
             if cls == "sharded":
-                local_size, ag_algos = _seg_algo_spec(topo_field,
-                                                      len(buckets))
+                local_size, ag_algos, _codecs = _seg_algo_spec(
+                    topo_field, len(buckets))
                 op_code, update_key, n_grads = code
                 shards = [reds[(si, bi)] for bi in range(len(buckets))]
                 if post != 1.0:
@@ -1309,14 +1727,18 @@ def build_replay_step(mesh: Mesh, axis: str, segments,
                 _unpack_flat(reds[(si, bi)], shapes, sizes, idxs, seg_outs)
                 for i in idxs:
                     outs[bases[si] + i] = seg_outs[i]
-        return tuple(outs)
+        return tuple(outs) + tuple(new_res[(si, bi)]
+                                   for si, bi, _ in res_layout)
 
     def body(*ts):  # each rank's own local tensors, natural shapes
         outs = [None] * n_tensors
+        new_res: dict = {}
         base = 0
-        for cls, code, pre, post, topo_field, shapes, buckets in segments:
+        for si, (cls, code, pre, post, topo_field, shapes,
+                 buckets) in enumerate(segments):
             sizes = [math.prod(s) for s in shapes]
-            local_size, algos = _seg_algo_spec(topo_field, len(buckets))
+            local_size, algos, codecs = _seg_algo_spec(topo_field,
+                                                       len(buckets))
             if cls == "sharded":
                 # rs -> shard-local update -> ag, fused in-stream: the
                 # sharded eager step replays as part of the same single
@@ -1325,12 +1747,20 @@ def build_replay_step(mesh: Mesh, axis: str, segments,
                 op = ReduceOp(op_code)
                 state = [ts[base + j] for j in range(n_grads, len(shapes))]
                 shards = []
-                for idxs in buckets:
+                for bi, idxs in enumerate(buckets):
                     flat = jnp.concatenate(
                         [jnp.ravel(ts[base + i]) for i in idxs])
                     if pre != 1.0:
                         flat = flat * pre
-                    shard = _rs_flat(flat, axis, n, op)
+                    if codecs[bi] == comp.CODEC_NONE:
+                        shard = _rs_flat(flat, axis, n, op)
+                    else:
+                        res = (ts[res_in[(si, bi)]]
+                               if (si, bi) in res_in else None)
+                        shard, nr = _rs_flat_codec(flat, res, axis, n, op,
+                                                   codecs[bi])
+                        if (si, bi) in res_in:
+                            new_res[(si, bi)] = nr
                     if post != 1.0:
                         shard = shard * post
                     shards.append(shard)
@@ -1351,14 +1781,18 @@ def build_replay_step(mesh: Mesh, axis: str, segments,
             if cls == "reduce":
                 reducers = _bucket_reducers(axis, ReduceOp(code), n,
                                             local_size, algos,
-                                            len(buckets))
+                                            len(buckets), codecs)
             for b, idxs in enumerate(buckets):
                 flat = jnp.concatenate(
                     [jnp.ravel(ts[base + i]) for i in idxs])
                 if cls == "reduce":
                     if pre != 1.0:
                         flat = flat * pre
-                    red = reducers[b](flat)
+                    res = (ts[res_in[(si, b)]]
+                           if (si, b) in res_in else None)
+                    red, nr = reducers[b](flat, res)
+                    if (si, b) in res_in:
+                        new_res[(si, b)] = nr
                     if post != 1.0:
                         red = red * post
                 else:
@@ -1369,14 +1803,17 @@ def build_replay_step(mesh: Mesh, axis: str, segments,
                         red, off, sizes[i]).reshape(shapes[i])
                     off += sizes[i]
             base += len(shapes)
-        return tuple(outs)
+        return tuple(outs) + tuple(new_res[(si, bi)]
+                                   for si, bi, _ in res_layout)
 
     # inputs are claimed-replicated world views (varying in truth) and the
     # outputs are replicated by construction — the VMA checker can infer
     # neither, same as the ladder builders above
     fn = _shmap(body_pipelined if pipeline else body, mesh, axis,
-                in_specs=tuple(P() for _ in range(n_tensors)),
-                out_specs=tuple(P() for _ in range(n_tensors)),
+                in_specs=tuple(P() for _ in
+                               range(n_tensors + len(res_layout))),
+                out_specs=tuple(P() for _ in
+                                range(n_tensors + len(res_layout))),
                 check_vma=False)
     return jax.jit(fn)
 

@@ -38,6 +38,7 @@ from .common.lru import lru_get, lru_put
 from .common.reduce_ops import ReduceOp, Average, Sum, Adasum
 from .metrics import registry as _metrics_registry
 from .ops import collectives as C
+from .ops import compression as _compression
 from .ops.adasum import adasum_p
 from .ops.compression import Compression
 
@@ -82,6 +83,8 @@ def allreduce_gradients(grads, axis_name: str, op: ReduceOp = Average,
     Leaves that are varying over ``axis_name`` (e.g. grads of explicitly
     device-local params) get the explicit collective.
     """
+    wire = getattr(compression, "wire_codec", None)
+
     def reduce_leaf(g):
         varying = _is_varying(g, axis_name)
         if op == Adasum:
@@ -103,6 +106,23 @@ def allreduce_gradients(grads, axis_name: str, op: ReduceOp = Average,
             return compression.decompress(
                 adasum_p(c, axis_name, axis_size), ctx)
         if varying:
+            if wire is not None:
+                if op not in (Average, Sum):
+                    raise ValueError(
+                        "wire-codec compression supports op=Average|Sum "
+                        "only")
+                # per-leaf codec resolution (the engine path's rule):
+                # non-float leaves never quantize, fp8 demotes to int8
+                # without a float8 dtype
+                rc = _compression.resolve_codec(wire, g.dtype)
+                if rc == _compression.CODEC_NONE:
+                    return C.allreduce_p(g, axis_name, op)
+                # one-shot wire-codec reduction: no residual carry here
+                # (this function is stateless) — use
+                # hvd.distributed(compression=...) for the error-feedback
+                # form, which threads the residual through its state
+                out, _ = C.ef_allreduce_p(g, None, axis_name, rc, op)
+                return out
             c, ctx = compression.compress(g)
             r = C.allreduce_p(c, axis_name, op)
             return compression.decompress(r, ctx)
@@ -120,6 +140,10 @@ class DistributedState(NamedTuple):
     inner_state: Any
     accum: Any          # local gradient accumulator (backward_passes_per_step)
     count: jnp.ndarray  # passes since last reduction
+    # error-feedback residual tree (ISSUE 13): present only under the
+    # fp8/int8 wire codecs — quantize(g + r) with the quantization error
+    # carried forward across reduce events
+    residual: Any = None
 
 
 def distributed(inner: optax.GradientTransformation, axis_name: str = "world",
@@ -156,18 +180,64 @@ def distributed(inner: optax.GradientTransformation, axis_name: str = "world",
         return _distributed_zero1(inner, axis_name, op, compression,
                                   backward_passes_per_step, axis_size,
                                   fusion_threshold_bytes)
+    # the wire-codec compressors (Compression.fp8/int8, ISSUE 13): the
+    # SPMD path applies them whole-payload inside the traced step, with
+    # the error-feedback residual carried in DistributedState (the engine
+    # holds it in engine state on the eager path)
+    wire = getattr(compression, "wire_codec", None)
+    ef = wire in _compression.EF_CODECS
+    if wire is not None and op not in (Average, Sum):
+        raise ValueError("wire-codec compression (Compression.fp8/int8) "
+                         "supports op=Average|Sum only")
+
+    def _ef_reduce(grads, residuals):
+        """Whole-payload error-feedback allreduce of a gradient tree:
+        returns (reduced, new_residuals). Per-leaf codec resolution (the
+        engine path's rule): non-float leaves take the plain collective,
+        fp8 demotes to int8 without a float8 dtype. Pre-summed
+        (unvarying) leaves moved no wire — nothing to compress, residual
+        unchanged."""
+        g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+        r_leaves = jax.tree_util.tree_leaves(residuals)
+        outs, new_rs = [], []
+        for g, r in zip(g_leaves, r_leaves):
+            if not _is_varying(g, axis_name) \
+                    and _vma_tracking_active(axis_name):
+                out = g / jax.lax.psum(1, axis_name) if op == Average \
+                    else g
+                outs.append(out)
+                new_rs.append(r)
+                continue
+            rc = _compression.resolve_codec(wire, g.dtype)
+            if rc == _compression.CODEC_NONE:
+                outs.append(C.allreduce_p(g, axis_name, op))
+                new_rs.append(r)
+                continue
+            out, new_r = C.ef_allreduce_p(g, r, axis_name, rc, op)
+            outs.append(out)
+            new_rs.append(new_r if new_r is not None else r)
+        return (jax.tree_util.tree_unflatten(treedef, outs),
+                jax.tree_util.tree_unflatten(treedef, new_rs))
 
     def init_fn(params):
         accum = jax.tree_util.tree_map(jnp.zeros_like, params) \
             if backward_passes_per_step > 1 else None
-        return DistributedState(inner.init(params), accum, jnp.zeros((), jnp.int32))
+        residual = (jax.tree_util.tree_map(jnp.zeros_like, params)
+                    if ef else None)
+        return DistributedState(inner.init(params), accum,
+                                jnp.zeros((), jnp.int32), residual)
 
     def update_fn(grads, state, params=None):
         if backward_passes_per_step == 1:
-            reduced = allreduce_gradients(grads, axis_name, op, compression,
-                                          axis_size)
+            if ef:
+                reduced, new_res = _ef_reduce(grads, state.residual)
+            else:
+                reduced = allreduce_gradients(grads, axis_name, op,
+                                              compression, axis_size)
+                new_res = state.residual
             updates, new_inner = inner.update(reduced, state.inner_state, params)
-            return updates, DistributedState(new_inner, state.accum, state.count)
+            return updates, DistributedState(new_inner, state.accum,
+                                             state.count, new_res)
 
         accum = jax.tree_util.tree_map(lambda a, g: a + g, state.accum, grads)
         count = state.count + 1
@@ -177,19 +247,25 @@ def distributed(inner: optax.GradientTransformation, axis_name: str = "world",
             # Reference semantics (torch/optimizer.py:122-149): grads are
             # *summed* across the k local passes — only the cross-replica
             # reduction averages. No /k here.
-            reduced = allreduce_gradients(accum, axis_name, op, compression,
-                                          axis_size)
+            if ef:
+                reduced, new_res = _ef_reduce(accum, state.residual)
+            else:
+                reduced = allreduce_gradients(accum, axis_name, op,
+                                              compression, axis_size)
+                new_res = state.residual
             updates, new_inner = inner.update(reduced, state.inner_state, params)
             zeroed = jax.tree_util.tree_map(jnp.zeros_like, accum)
-            return updates, new_inner, zeroed, jnp.zeros((), jnp.int32)
+            return (updates, new_inner, zeroed, jnp.zeros((), jnp.int32),
+                    new_res)
 
         def skip(_):
             zero_up = jax.tree_util.tree_map(jnp.zeros_like, grads)
-            return zero_up, state.inner_state, accum, count
+            return zero_up, state.inner_state, accum, count, state.residual
 
-        updates, new_inner, new_accum, new_count = jax.lax.cond(
+        updates, new_inner, new_accum, new_count, new_res = jax.lax.cond(
             do_step, reduce_and_step, skip, operand=None)
-        return updates, DistributedState(new_inner, new_accum, new_count)
+        return updates, DistributedState(new_inner, new_accum, new_count,
+                                         new_res)
 
     return optax.GradientTransformation(init_fn, update_fn)
 
@@ -276,9 +352,11 @@ def _distributed_zero1(inner: optax.GradientTransformation, axis_name: str,
         raise ValueError("shard_optimizer=True supports op=Average|Sum only "
                          "(Adasum mixes whole updates, not shards)")
     if compression is not Compression.none:
-        raise ValueError("shard_optimizer=True does not compose with wire "
-                         "compression (the packed buffers are dtype-uniform "
-                         "already); use Compression.none")
+        raise ValueError("the SPMD shard_optimizer=True path does not "
+                         "compose with compression; the eager "
+                         "DistributedEagerOptimizer(sharded=True, "
+                         "compression=Compression.int8) path compresses "
+                         "its reduce-scatter legs (docs/compression.md)")
     if backward_passes_per_step != 1:
         raise ValueError("shard_optimizer=True requires "
                          "backward_passes_per_step=1 (accumulate locally "
@@ -423,10 +501,20 @@ class DistributedEagerOptimizer:
         self.inner = inner
         self.op = op
         self.compression = compression
+        # wire-codec compressors (Compression.fp8/int8, ISSUE 13): the
+        # frontend leaves tensors untouched and the ENGINE encodes the
+        # collective's slow-link payload per fusion bucket, error-
+        # feedback residuals held in engine state — the codec override
+        # rides every grouped_allreduce/sharded_step this optimizer
+        # submits
+        self._wire_codec = getattr(compression, "wire_codec", None)
         self.backward_passes_per_step = backward_passes_per_step
         self.sparse_rows = dict(sparse_rows or {})
         if self.sparse_rows and op not in (Average, Sum):
             raise ValueError("sparse_rows supports op=Average|Sum only")
+        if self._wire_codec is not None and op not in (Average, Sum):
+            raise ValueError("wire-codec compression (Compression.fp8/"
+                             "int8) supports op=Average|Sum only")
         # ZeRO-1 optimizer-state sharding (docs/sharded_optimizer.md):
         # None defers to the HOROVOD_TPU_SHARD_OPTIMIZER config knob (also
         # an autotune categorical), resolved once at state init so a knob
@@ -443,9 +531,14 @@ class DistributedEagerOptimizer:
             if op not in (Average, Sum):
                 raise ValueError(
                     "sharded=True supports op=Average|Sum only")
-            if compression is not Compression.none:
+            if compression is not Compression.none \
+                    and self._wire_codec is None:
                 raise ValueError(
-                    "sharded=True does not compose with wire compression")
+                    "sharded=True composes only with wire-codec "
+                    "compression (Compression.fp8/int8, applied to the "
+                    "reduce-scatter legs) or Compression.none — cast "
+                    "compressors would change the packed buffers' "
+                    "dtype-uniform layout")
             if self.sparse_rows:
                 raise ValueError(
                     "sharded=True does not compose with sparse_rows")
@@ -471,7 +564,9 @@ class DistributedEagerOptimizer:
             self._sharded = bool(st.initialized
                                  and st.config.shard_optimizer)
             if self._sharded and (self.op not in (Average, Sum)
-                                  or self.compression is not Compression.none
+                                  or (self.compression is not
+                                      Compression.none
+                                      and self._wire_codec is None)
                                   or self.sparse_rows):
                 # config-driven opt-in must not silently change an
                 # incompatible optimizer; fall back to replicated
@@ -572,7 +667,8 @@ class DistributedEagerOptimizer:
             handles = eng.sharded_step(
                 leaves, shard_update, update_key, state_leaves,
                 name=f"grad.zero.s{self._step}", op=self.op,
-                buckets=[list(idxs) for idxs, _, _, _ in layout])
+                buckets=[list(idxs) for idxs, _, _, _ in layout],
+                codec=self._wire_codec)
         finally:
             eng.step_end()
         # dispatch-phase wall time (pack + the fused rs->update->ag launch;
@@ -735,7 +831,8 @@ class DistributedEagerOptimizer:
                 for i, c in enumerate(compressed)]
         elif compressed:
             handles = eng.grouped_allreduce(
-                compressed, name=f"grad.s{self._step}", op=self.op)
+                compressed, name=f"grad.s{self._step}", op=self.op,
+                codec=self._wire_codec)
         else:
             handles = []
         reduced = [None] * len(leaves)
@@ -939,6 +1036,11 @@ class DistributedDeltaAdasumOptimizer:
                  backward_passes_per_step: int = 1):
         self.inner = inner
         self.compression = compression
+        if getattr(compression, "wire_codec", None) is not None:
+            raise ValueError(
+                "delta-Adasum has no wire-codec path (Adasum mixes whole "
+                "updates, not additive sums); use Compression.none/fp16/"
+                "bf16")
         self.backward_passes_per_step = backward_passes_per_step
         self._accum = None
         self._count = 0

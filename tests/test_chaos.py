@@ -744,3 +744,113 @@ class TestDurableRestoreChaos:
         finally:
             gs.checkpoint_manager.close(flush=False)
             gs.checkpoint_manager = None
+
+# ---------------------------------------------------------------------------
+# ISSUE 13 acceptance: a compressed step recovers through elastic restore
+# with error-feedback residuals invalidated (never poisoned)
+# ---------------------------------------------------------------------------
+
+
+def test_compressed_step_recovers_through_elastic_restore():
+    """An injected encode failure on a compressed step surfaces as
+    HorovodInternalError, the elastic run-loop restores committed state
+    and re-initializes (fresh engine — the pre-failure residual lineage
+    is dropped with its world: invalidated, never poisoned), and training
+    resumes COMPRESSED to the target with residuals repopulating; a later
+    world-version bump invalidates the new lineage through the counted gc
+    edge. The codec needs a >1 world view to engage, installed the
+    heterogeneous-topology test's way (the in-process chaos world is one
+    rank; multi-rank compressed parity lives in test_multiprocess)."""
+    import dataclasses
+    import jax
+    import jax.numpy as jnp
+    from horovod_tpu.core.state import global_state
+    from horovod_tpu.metrics import counter_total, snapshot
+    hvd.shutdown()
+    os.environ["HOROVOD_TPU_COMPRESSION"] = "int8"
+    hvd.init()
+
+    def ctr(name):
+        return counter_total(snapshot(), name)
+
+    def compressed_view():
+        # idempotent: the rebuilt engine after an elastic reset re-detects
+        # the one-process world, so every step re-installs the >1 view
+        # the codec resolution keys on
+        eng = global_state().engine
+        if eng.topology.size <= 1:
+            eng.topology = dataclasses.replace(eng.topology, size=2)
+        return eng
+
+    rec_before = registry().counter(
+        "hvd_tpu_elastic_recoveries_total").value(kind="internal")
+    try:
+        compressed_view()
+        box = {"p": {"w": jnp.ones((6, 6))}, "i": 0}
+        grad_fn = jax.jit(jax.grad(lambda p: jnp.sum(p["w"] ** 2)))
+
+        def one_step():
+            # the engine's compressed grouped path, bracketed as one step
+            # (the DistributedEagerOptimizer short-circuits the engine on
+            # one-rank worlds, so the chaos loop drives it directly)
+            eng = compressed_view()
+            g = grad_fn(box["p"])
+            leaves, treedef = jax.tree_util.tree_flatten(g)
+            eng.step_begin()
+            try:
+                hs = eng.grouped_allreduce(
+                    leaves, name=f"cz.s{box['i']}",
+                    op=hvd.ReduceOp.SUM, codec="int8")
+                red = [h.result() for h in hs]
+            finally:
+                eng.step_end()
+            box["i"] += 1
+            g2 = jax.tree_util.tree_unflatten(treedef, red)
+            box["p"] = jax.tree_util.tree_map(
+                lambda p, gg: p - 0.05 * gg, box["p"], g2)
+
+        # residual lineage exists BEFORE the fault
+        for _ in range(3):
+            one_step()
+        jax.block_until_ready(box["p"]["w"])
+        eng_before = global_state().engine
+        assert len(eng_before._ef_residuals) > 0
+        assert ctr("hvd_tpu_compression_codec_total") > 0
+        faults.arm("compression.encode=1*raise(HorovodInternalError)")
+        state = _CountingState(batch=0)
+        target = 6
+
+        @hvd.elastic.run
+        def train(state):
+            while state.batch < target:
+                one_step()
+                state.batch += 1
+                state.commit()
+            return state.batch
+
+        assert train(state) == target
+        jax.block_until_ready(box["p"]["w"])
+        eng_after = global_state().engine
+        assert state.restores == 1, "run-loop never restored"
+        assert faults.hits("compression.encode") == 1
+        assert registry().counter(
+            "hvd_tpu_elastic_recoveries_total").value(kind="internal") \
+            == rec_before + 1
+        # fresh engine, fresh residual lineage — repopulated by the
+        # post-restore compressed steps (invalidated, never poisoned)
+        assert eng_after is not eng_before
+        assert len(eng_after._ef_residuals) > 0
+        assert bool(np.isfinite(np.asarray(box["p"]["w"])).all())
+        # the counted world-version-bump invalidation edge on the NEW
+        # lineage
+        inval0 = ctr("hvd_tpu_compression_residual_invalidations_total")
+        os.environ["HOROVOD_TPU_WORLD_VERSION"] = \
+            str(eng_after.world_version + 2)
+        one_step()
+        assert ctr("hvd_tpu_compression_residual_invalidations_total") \
+            > inval0
+    finally:
+        faults.disarm()
+        os.environ.pop("HOROVOD_TPU_COMPRESSION", None)
+        os.environ.pop("HOROVOD_TPU_WORLD_VERSION", None)
+        hvd.shutdown()

@@ -1,0 +1,620 @@
+"""Link-aware gradient compression (ISSUE 13).
+
+Unit surface: codec primitives (round-trip error bounds per dtype,
+error-feedback residual semantics), the per-link split (ICI legs stay
+full precision and bit-exact, only the DCN payload is encoded), the
+reducer numerics on the 8-device CPU mesh (hierarchical ladder + the
+whole-payload flat/tree fallback + the ZeRO-1 compressed reduce-scatter's
+ownership invariant), the compressor-surface parity fixes, the engine's
+residual registry invalidation contract, replay re-arm on a codec knob
+move, and the SPMD error-feedback path. Real-world trajectory / DCN-drop
+acceptance lives in tests/test_multiprocess.py; chaos recovery in
+tests/test_chaos.py.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.common.reduce_ops import ReduceOp
+from horovod_tpu.ops import collectives as C
+from horovod_tpu.ops import compression as comp
+
+
+def _world_mesh():
+    devs = jax.devices()
+    return Mesh(np.array(devs), ("world",)), len(devs)
+
+
+def _rep(mesh, x):
+    return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+def _stacked(mesh, x):
+    return jax.device_put(x, NamedSharding(mesh, P("world")))
+
+
+# ---------------------------------------------------------------------------
+# codec primitives
+# ---------------------------------------------------------------------------
+
+class TestCodecPrimitives:
+    def test_int8_round_trip_error_bound(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(512), jnp.float32)
+        payload, scale = comp.encode(x, "int8")
+        assert payload.dtype == jnp.int8 and scale.shape == (1,)
+        back = comp.decode(payload, scale, "int8", jnp.float32)
+        amax = float(jnp.max(jnp.abs(x)))
+        # symmetric linear quantization: half a step per element
+        assert float(jnp.max(jnp.abs(back - x))) <= amax / 127 / 2 + 1e-6
+
+    def test_fp8_round_trip_error_bound(self):
+        if comp._FP8_DTYPE is None:
+            pytest.skip("no float8 dtype on this jax")
+        x = jnp.asarray(np.random.RandomState(1).randn(512), jnp.float32)
+        payload, scale = comp.encode(x, "fp8")
+        back = comp.decode(payload, scale, "fp8", jnp.float32)
+        # e4m3 keeps ~3 mantissa bits: relative error <= 2^-4 of the
+        # element (plus the scale's own rounding)
+        assert float(jnp.max(jnp.abs(back - x))) <= \
+            float(jnp.max(jnp.abs(x))) * 0.07 + 1e-6
+
+    def test_bf16_round_trip(self):
+        x = jnp.asarray(np.random.RandomState(2).randn(512), jnp.float32)
+        payload, scale = comp.encode(x, "bf16")
+        assert payload.dtype == jnp.bfloat16 and scale is None
+        back = comp.decode(payload, None, "bf16", jnp.float32)
+        assert float(jnp.max(jnp.abs(back - x))) <= \
+            float(jnp.max(jnp.abs(x))) * 2 ** -8
+
+    def test_ef_encode_residual_semantics(self):
+        """quantize(g + r): the new residual is exactly the quantization
+        error of the residual-corrected payload."""
+        x = jnp.asarray(np.random.RandomState(3).randn(256), jnp.float32)
+        r = jnp.asarray(np.random.RandomState(4).randn(256) * 0.01,
+                        jnp.float32)
+        payload, scale, new_r = comp.ef_encode(x, r, "int8")
+        back = comp.decode(payload, scale, "int8", jnp.float32)
+        np.testing.assert_allclose(np.asarray(new_r),
+                                   np.asarray(x + r - back), atol=1e-6)
+        # residual=None means a fresh (zero) buffer
+        p2, s2, r2 = comp.ef_encode(x, None, "int8")
+        back2 = comp.decode(p2, s2, "int8", jnp.float32)
+        np.testing.assert_allclose(np.asarray(r2),
+                                   np.asarray(x - back2), atol=1e-6)
+
+    def test_resolve_codec_rules(self):
+        assert comp.resolve_codec("int8", jnp.float32) == "int8"
+        assert comp.resolve_codec("none", jnp.float32) == "none"
+        # non-float payloads are never quantized
+        assert comp.resolve_codec("int8", jnp.int32) == "none"
+        assert comp.resolve_codec("bf16", jnp.int64) == "none"
+        # bf16 on an already-16-bit float payload is a no-op
+        assert comp.resolve_codec("bf16", jnp.bfloat16) == "none"
+        assert comp.resolve_codec("bf16", jnp.float32) == "bf16"
+
+    def test_fp8_demotes_to_int8_without_float8(self, monkeypatch):
+        monkeypatch.setattr(comp, "_FP8_DTYPE", None)
+        monkeypatch.setattr(comp, "_warned_codec", set())
+        assert comp.resolve_codec("fp8", jnp.float32) == "int8"
+
+    def test_wire_itemsize(self):
+        assert comp.wire_itemsize("none", 4) == 4
+        assert comp.wire_itemsize("bf16", 4) == 2
+        assert comp.wire_itemsize("fp8", 4) == 1
+        assert comp.wire_itemsize("int8", 4) == 1
+        assert comp.wire_itemsize("bf16", 2) == 2  # never grows
+
+
+# ---------------------------------------------------------------------------
+# compressor surface (Horovod parity + ISSUE 13 satellite fix)
+# ---------------------------------------------------------------------------
+
+class TestCompressorSurface:
+    def test_wire_codec_compressors_exported(self):
+        assert hvd.Compression.fp8.wire_codec == "fp8"
+        assert hvd.Compression.int8.wire_codec == "int8"
+        assert hvd.Compression.none.wire_codec is None
+        assert hvd.Compression.fp16.wire_codec is None
+        # frontend compress/decompress are identity for the wire codecs
+        x = jnp.ones((4,), jnp.float32)
+        c, ctx = hvd.Compression.int8.compress(x)
+        assert c is x and ctx is None
+        assert hvd.Compression.int8.decompress(c, ctx) is x
+
+    @pytest.mark.parametrize("cls", [hvd.Compression.fp16,
+                                     hvd.Compression.bf16])
+    def test_cast_compressor_nonfloat_ctx_is_none(self, cls):
+        """Satellite fix: compress leaves int tensors untouched and must
+        return ctx=None so decompress is a true no-op (the old ctx=dtype
+        issued a pointless astype on every integer bucket)."""
+        x = jnp.arange(8, dtype=jnp.int32)
+        c, ctx = cls.compress(x)
+        assert ctx is None
+        assert c.dtype == jnp.int32
+        out = cls.decompress(c, ctx)
+        assert out is c
+
+    @pytest.mark.parametrize("cls,wire_dtype", [
+        (hvd.Compression.fp16, jnp.float16),
+        (hvd.Compression.bf16, jnp.bfloat16)])
+    def test_cast_compressor_float_round_trip(self, cls, wire_dtype):
+        x = jnp.asarray([1.5, -2.25], jnp.float32)
+        c, ctx = cls.compress(x)
+        assert c.dtype == wire_dtype and ctx == jnp.float32
+        assert cls.decompress(c, ctx).dtype == jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# per-link split math
+# ---------------------------------------------------------------------------
+
+class TestLinkSplitCodec:
+    def test_hierarchical_dcn_leg_encoded_ici_unchanged(self):
+        # 4096 fp32 bytes, local_size 4: dcn_raw = 1024, ici = 3072
+        base = C.link_split("hierarchical", 4096, 4)
+        assert base == {"dcn": 1024, "ici": 3072}
+        i8 = C.link_split("hierarchical", 4096, 4, codec="int8",
+                          itemsize=4)
+        assert i8 == {"dcn": 256, "ici": 3072}   # 4x drop, ICI untouched
+        bf = C.link_split("hierarchical", 4096, 4, codec="bf16",
+                          itemsize=4)
+        assert bf == {"dcn": 512, "ici": 3072}
+
+    def test_flat_fallback_half_encoded(self):
+        """The flat/tree fallback is compressed-RS + full-precision AG:
+        half the payload movement is encoded, the return half is not —
+        the accounting matches the program's actual shape."""
+        assert C.link_split("flat", 4096, 1, codec="int8",
+                            itemsize=4) == {"flat": 2048 // 4 + 2048}
+        assert C.link_split("flat", 4096, 1, codec="bf16",
+                            itemsize=4) == {"flat": 2048 // 2 + 2048}
+        assert C.link_split("flat", 4096, 1) == {"flat": 4096}
+        # a reduce-scatter is all encoded (no return leg)
+        assert C.link_split("flat", 4096, 1, kind="reducescatter",
+                            codec="int8", itemsize=4) == {"flat": 1024}
+
+    def test_allgather_never_encoded(self):
+        assert C.link_split("hierarchical", 4096, 4, kind="allgather",
+                            codec="int8", itemsize=4) == {"dcn": 4096}
+        assert C.link_split("flat", 4096, 1, kind="allgather",
+                            codec="int8", itemsize=4) == {"flat": 4096}
+
+    def test_residual_elems_rules(self):
+        # hierarchical: the local-RS shard (padded to local_size)
+        assert C.codec_residual_elems("reduce", 1000, 8, 4,
+                                      "hierarchical", "int8") == 250
+        assert C.codec_residual_elems("reduce", 1001, 8, 4,
+                                      "hierarchical", "int8") == 251
+        # flat/tree fallback: the whole zero-padded payload (the
+        # compressed reduce-scatter's pre-scatter encode)
+        assert C.codec_residual_elems("reduce", 1000, 8, 4, "flat",
+                                      "int8") == 1000
+        assert C.codec_residual_elems("reduce", 1001, 8, 4, "flat",
+                                      "int8") == 1008
+        # sharded rs leg: the zero-padded flat bucket
+        assert C.codec_residual_elems("sharded", 1000, 8, 0, None,
+                                      "int8") == C.shard_spec(1000, 8)[0]
+        # non-EF codecs carry no residual
+        assert C.codec_residual_elems("reduce", 1000, 8, 4, "flat",
+                                      "bf16") is None
+
+
+# ---------------------------------------------------------------------------
+# reducer numerics on the 8-device mesh
+# ---------------------------------------------------------------------------
+
+class TestCodecReducers:
+    def _data(self, n, elems, seed=0):
+        rng = np.random.RandomState(seed)
+        return rng.randn(n, elems).astype(np.float32)
+
+    def test_flat_int8_error_bound_and_residual(self):
+        mesh, n = _world_mesh()
+        elems = 1000
+        data = self._data(n, elems)
+        exact = data.sum(0)
+        fn = C.build_grouped_allreduce(
+            mesh, "world", ReduceOp.SUM, ((elems,),), [jnp.float32],
+            [[0]], algos=("flat",), codecs=("int8",))
+        out, new_res = fn(_stacked(mesh, jnp.asarray(data)),
+                          _rep(mesh, jnp.zeros((elems,), jnp.float32)))
+        # one quantization step (amax/127) of error per contribution
+        bound = (np.abs(data).max(axis=1) / 127 / 2).sum() + 1e-5
+        assert np.abs(np.asarray(out) - exact).max() <= bound
+        # the returned residual is rank 0's own quantization error
+        # (process 0 owns device 0's shard of the world view)
+        p0, s0 = comp.encode(jnp.asarray(data[0]), "int8")
+        want = data[0] - np.asarray(
+            comp.decode(p0, s0, "int8", jnp.float32))
+        np.testing.assert_allclose(np.asarray(new_res), want, atol=1e-5)
+
+    def test_residual_carry_across_steps(self):
+        """quantize(g + r) telescopes: the K-step cumulative decoded sum
+        differs from the exact cumulative sum by exactly the FINAL
+        residuals (sum_t decoded_t = sum_t x_t + r_0 - r_K per rank), so
+        the cumulative error stays bounded by one quantization step while
+        fresh per-step quantization accumulates K steps of error."""
+        mesh, n = _world_mesh()
+        elems = 400
+        K = 4
+        data = self._data(n, elems, seed=7) * 0.01
+        fn = C.build_grouped_allreduce(
+            mesh, "world", ReduceOp.SUM, ((elems,),), [jnp.float32],
+            [[0]], algos=("flat",), codecs=("int8",))
+        arg = _stacked(mesh, jnp.asarray(data))
+        zeros = _rep(mesh, jnp.zeros((elems,), jnp.float32))
+        cum_ef = np.zeros(elems, np.float32)
+        cum_fresh = np.zeros(elems, np.float32)
+        res = zeros
+        for _ in range(K):
+            out_ef, res_arr = fn(arg, res)
+            # feed the residual back AS the claimed-replicated global
+            # array: each device keeps ITS OWN residual shard (the
+            # engine's world-view convention) — a host round-trip would
+            # collapse every device onto device 0's residual
+            res = res_arr
+            cum_ef += np.asarray(out_ef)
+            out_fresh, _ = fn(arg, zeros)
+            cum_fresh += np.asarray(out_fresh)
+        exact = data.sum(0) * K
+        err_ef = np.abs(cum_ef - exact).max()
+        err_fresh = np.abs(cum_fresh - exact).max()
+        # EF cumulative error is bounded by the final residuals — one
+        # half-step per contributor — independent of K
+        one_step = (np.abs(data).max(axis=1) / 127).sum() + 1e-5
+        assert err_ef <= one_step
+        assert err_ef < err_fresh
+
+    def test_hierarchical_ici_legs_bit_exact(self):
+        """Only the DCN payload is encoded: integer-valued data whose
+        quantization grid is exact (amax=127 -> scale=1) must come back
+        BITWISE equal to the uncompressed flat sum — any ICI-leg encoding
+        would still be exact here, but a whole-payload error would not
+        telescope away; combined with the non-exact case below this pins
+        the encode to the cross-slice exchange."""
+        mesh, n = _world_mesh()
+        elems = 512
+        rng = np.random.RandomState(11)
+        # integer data whose SLICE-LOCAL sums stay in [-127, 127] with
+        # amax pinned to exactly 127 in EVERY per-rank shard chunk (the
+        # encode sees the post-local-RS shard — elems/local contiguous
+        # positions of the slice sum): scale = 1.0 on every chunk, every
+        # value on the grid -> the DCN encode is exact end to end
+        data = rng.randint(-7, 8, size=(n, elems)).astype(np.float32)
+        chunk = elems // 4   # local_size=4 shard length
+        for j in range(4):
+            data[:, j * chunk] = 0.0
+            data[::4, j * chunk] = 127.0   # local idx 0 of each slice
+        exact = data.sum(0)
+        fn = C.build_grouped_allreduce(
+            mesh, "world", ReduceOp.SUM, ((elems,),), [jnp.float32],
+            [[0]], local_size=4, algos=("hierarchical",),
+            codecs=("int8",))
+        res = _rep(mesh, jnp.zeros(
+            (C.codec_residual_elems("reduce", elems, n, 4,
+                                    "hierarchical", "int8"),),
+            jnp.float32))
+        out, new_res = fn(_stacked(mesh, jnp.asarray(data)), res)
+        np.testing.assert_array_equal(np.asarray(out), exact)
+        # exact grid -> zero residual
+        assert float(np.abs(np.asarray(new_res)).max()) == 0.0
+
+    def test_hierarchical_int8_error_scales_with_dcn_traffic(self):
+        """The hierarchical ladder quantizes the post-local-RS shard (the
+        cross-slice contribution), so the error bound is the CROSS count
+        (n/local) of quantization steps — not the world count."""
+        mesh, n = _world_mesh()
+        local = 4
+        elems = 1024
+        data = self._data(n, elems, seed=5)
+        exact = data.sum(0)
+        fn = C.build_grouped_allreduce(
+            mesh, "world", ReduceOp.SUM, ((elems,),), [jnp.float32],
+            [[0]], local_size=local, algos=("hierarchical",),
+            codecs=("int8",))
+        res = _rep(mesh, jnp.zeros((elems // local,), jnp.float32))
+        out, _ = fn(_stacked(mesh, jnp.asarray(data)), res)
+        # each slice's local sum has amax <= local * max|x|; cross slices
+        # contribute (n/local) half-steps of that scale
+        amax = np.abs(data).max() * local
+        bound = (n // local) * amax / 127 / 2 + 1e-4
+        assert np.abs(np.asarray(out) - exact).max() <= bound
+
+    def test_bf16_codec_no_residual_io(self):
+        mesh, n = _world_mesh()
+        elems = 256
+        data = self._data(n, elems, seed=9)
+        fn = C.build_grouped_allreduce(
+            mesh, "world", ReduceOp.SUM, ((elems,),), [jnp.float32],
+            [[0]], algos=("flat",), codecs=("bf16",))
+        outs = fn(_stacked(mesh, jnp.asarray(data)))
+        assert len(outs) == 1   # no residual output
+        exact = data.sum(0)
+        assert np.abs(np.asarray(outs[0]) - exact).max() <= \
+            np.abs(data).sum(0).max() * 2 ** -7
+
+    def test_average_op(self):
+        mesh, n = _world_mesh()
+        elems = 128
+        data = self._data(n, elems, seed=13)
+        fn = C.build_grouped_allreduce(
+            mesh, "world", ReduceOp.AVERAGE, ((elems,),), [jnp.float32],
+            [[0]], algos=("flat",), codecs=("int8",))
+        out, _ = fn(_stacked(mesh, jnp.asarray(data)),
+                    _rep(mesh, jnp.zeros((elems,), jnp.float32)))
+        exact = data.mean(0)
+        bound = (np.abs(data).max(axis=1) / 127 / 2).sum() / n + 1e-5
+        assert np.abs(np.asarray(out) - exact).max() <= bound
+
+    def test_sharded_rs_codec_ownership_and_bound(self):
+        """The compressed reduce-scatter keeps the pinned-flat ownership:
+        rank r's shard is chunk r of the decoded sum, exactly
+        shard_spec's rule."""
+        mesh, n = _world_mesh()
+        elems = 1000   # non-divisible: exercises the padding
+        data = self._data(n, elems, seed=17)
+        exact = data.sum(0)
+        padded, shard = C.shard_spec(elems, n)
+
+        def upd(shards, state):
+            return shards, state
+
+        fn = C.build_sharded_step(
+            mesh, "world", ReduceOp.SUM, ((elems,),), [jnp.float32],
+            [[0]], (), (), upd, codecs=("int8",))
+        out, new_res = fn(_stacked(mesh, jnp.asarray(data)),
+                          _rep(mesh, jnp.zeros((padded,), jnp.float32)))
+        bound = (np.abs(data).max(axis=1) / 127 / 2).sum() + 1e-5
+        assert np.abs(np.asarray(out) - exact).max() <= bound
+        # the uncompressed form must agree within the same bound (same
+        # ownership: unpack reassembles chunks in rank order)
+        fn0 = C.build_sharded_step(
+            mesh, "world", ReduceOp.SUM, ((elems,),), [jnp.float32],
+            [[0]], (), (), upd)
+        (out0,) = fn0(_stacked(mesh, jnp.asarray(data)))
+        assert np.abs(np.asarray(out) - np.asarray(out0)).max() <= bound
+
+    def test_replay_step_codec_residual_io(self):
+        """The replay builder threads residuals: one extra input/output
+        per EF bucket, in replay_residual_layout order."""
+        mesh, n = _world_mesh()
+        elems = 300
+        segs = (("reduce", int(ReduceOp.SUM), 1.0, 1.0,
+                 (4, ("hierarchical",), ("int8",)), ((elems,),), ((0,),)),)
+        layout = C.replay_residual_layout(segs, n)
+        assert layout == [(0, 0, C.codec_residual_elems(
+            "reduce", elems, n, 4, "hierarchical", "int8"))]
+        fn = C.build_replay_step(mesh, "world", segs, pipeline=True)
+        x = _rep(mesh, jnp.ones((elems,), jnp.float32))
+        res = _rep(mesh, jnp.zeros((layout[0][2],), jnp.float32))
+        outs = fn(x, res)
+        assert len(outs) == 2
+        # identical contributions quantize exactly when amax aligns or
+        # at worst within one step per cross slice
+        assert np.abs(np.asarray(outs[0]) - n).max() < 0.1
+
+    def test_seg_algo_spec_codec_field(self):
+        local, algos, codecs = C._seg_algo_spec((4, ("flat", "tree")), 2)
+        assert codecs == ("none", "none")
+        local, algos, codecs = C._seg_algo_spec(
+            (4, ("flat",), ("int8",)), 1)
+        assert codecs == ("int8",)
+        local, algos, codecs = C._seg_algo_spec(2, 1)   # legacy int form
+        assert local == 2 and codecs == ("none",)
+
+    def test_spmd_ef_allreduce_p(self):
+        """The in-shard_map EF primitive hvd.distributed rides."""
+        mesh, n = _world_mesh()
+        from jax import shard_map
+
+        def body(x, r):
+            out, new_r = C.ef_allreduce_p(x[0], r, "world", "int8",
+                                          ReduceOp.SUM)
+            return out, new_r
+
+        fn = jax.jit(shard_map(body, mesh=mesh,
+                               in_specs=(P("world"), P()),
+                               out_specs=(P(), P()), check_vma=False))
+        data = self._data(n, 200, seed=21)
+        out, new_r = fn(_stacked(mesh, jnp.asarray(data)),
+                        _rep(mesh, jnp.zeros((200,), jnp.float32)))
+        exact = data.sum(0)
+        bound = (np.abs(data).max(axis=1) / 127 / 2).sum() + 1e-5
+        assert np.abs(np.asarray(out) - exact).max() <= bound
+
+
+# ---------------------------------------------------------------------------
+# SPMD optimizer path (hvd.distributed(compression=...))
+# ---------------------------------------------------------------------------
+
+class TestSPMDDistributedEF:
+    def test_int8_trains_close_to_none(self, mesh8):
+        import optax
+        from jax import shard_map
+        from horovod_tpu.optimizer import distributed
+
+        n = 8
+        params0 = {"w": jnp.ones((16,), jnp.float32)}
+        data = jnp.asarray(
+            np.random.RandomState(3).randn(n, 16).astype(np.float32))
+
+        def make_step(compression):
+            opt = distributed(optax.sgd(0.05), axis_name="world",
+                              compression=compression)
+
+            def body(p, st_ref, x):
+                def loss(p):
+                    return jnp.sum((p["w"] - x[0]) ** 2)
+                g = jax.grad(loss)(p)
+                up, st = opt.update(g, st_ref, p)
+                return optax.apply_updates(p, up), st
+
+            fn = jax.jit(shard_map(
+                body, mesh=mesh8,
+                in_specs=(P(), P(), P("world")), out_specs=(P(), P()),
+                check_vma=False))
+            return opt, fn
+
+        opt_n, fn_n = make_step(hvd.Compression.none)
+        opt_q, fn_q = make_step(hvd.Compression.int8)
+        pn, sn = dict(params0), opt_n.init(params0)
+        pq, sq = dict(params0), opt_q.init(params0)
+        assert sq.residual is not None and sn.residual is None
+        for _ in range(12):
+            pn, sn = fn_n(pn, sn, data)
+            pq, sq = fn_q(pq, sq, data)
+        err = float(np.abs(np.asarray(pn["w"]) - np.asarray(pq["w"]))
+                    .max())
+        assert err < 5e-2, err
+        # the residual evolved (quantization error was carried)
+        assert float(np.abs(np.asarray(
+            jax.tree_util.tree_leaves(sq.residual)[0])).max()) > 0
+
+    def test_wire_codec_rejects_non_additive_ops(self):
+        import optax
+        from horovod_tpu.optimizer import distributed
+        with pytest.raises(ValueError, match="Average|Sum"):
+            distributed(optax.sgd(0.1), op=hvd.Adasum,
+                        compression=hvd.Compression.int8, axis_size=8)
+
+
+# ---------------------------------------------------------------------------
+# engine residual registry + knob plumbing (size-1 world: unit level)
+# ---------------------------------------------------------------------------
+
+class TestEngineResidualRegistry:
+    def test_fetch_store_invalidate(self):
+        hvd.init()
+        eng = hvd._engine()
+        key = ("gar", "t.#", 0, "flat", "int8", 64, "float32")
+        # fresh fetch is zeros
+        buf = eng._residual_fetch(key, 64, jnp.float32)
+        assert float(np.abs(np.asarray(buf)).max()) == 0.0
+        eng._residual_store(key, jnp.ones((64,), jnp.float32))
+        got = eng._residual_fetch(key, 64, jnp.float32)
+        assert float(np.asarray(got).min()) == 1.0
+        # shape drift -> fresh zeros (fusion-layout move)
+        assert float(np.abs(np.asarray(
+            eng._residual_fetch(key, 32, jnp.float32))).max()) == 0.0
+        eng.invalidate_residuals("test")
+        assert len(eng._ef_residuals) == 0
+        got = eng._residual_fetch(key, 64, jnp.float32)
+        assert float(np.abs(np.asarray(got)).max()) == 0.0
+
+    def test_world_version_bump_sweeps_residuals(self):
+        hvd.init()
+        eng = hvd._engine()
+        key = ("gar", "wv.#", 0, "flat", "int8", 8, "float32")
+        eng._residual_store(key, jnp.ones((8,), jnp.float32))
+        assert key in eng._ef_residuals
+        eng.world_version += 1
+        try:
+            eng._prefetch_gc()
+            assert key not in eng._ef_residuals
+        finally:
+            eng.world_version -= 1
+
+    def test_size1_world_resolves_codec_none(self):
+        """A single-rank world moves no wire: the codec is always off,
+        whatever the knob or the per-call override says."""
+        hvd.init()
+        eng = hvd._engine()
+        if eng.backend.size() > 1:
+            pytest.skip("needs the in-process size-1 world")
+        assert eng._call_codec("int8") == "none"
+        prev = eng.config.compression
+        try:
+            eng.config.compression = "int8"
+            assert eng._call_codec(None) == "none"
+        finally:
+            eng.config.compression = prev
+
+    def test_algo_sig_includes_compression_knob(self):
+        hvd.init()
+        eng = hvd._engine()
+        prev = eng.config.compression
+        try:
+            eng.config.compression = "none"
+            a = eng._algo_sig()
+            eng.config.compression = "int8"
+            b = eng._algo_sig()
+            assert a != b
+        finally:
+            eng.config.compression = prev
+
+    def test_replay_rearms_on_codec_knob_move(self):
+        """The PR 10 algo_sig pattern applied to the codec knob: a live
+        move of HOROVOD_TPU_COMPRESSION (autotune categorical) rebuilds
+        armed replay programs."""
+        hvd.init()
+        eng = hvd._engine()
+        prev = (eng.config.step_replay_warmup, eng.config.compression)
+        eng.config.step_replay_warmup = 2
+        eng.replay.invalidate_all("test isolation")
+        tensors = [jnp.ones((8,), jnp.float32) for _ in range(2)]
+        try:
+            for i in range(3):
+                eng.step_begin()
+                hvd.grouped_allreduce(list(tensors), name=f"cc.{i}",
+                                      op=hvd.Sum)
+                eng.step_end()
+            assert eng.replay.replayed_steps >= 1
+            armed = [e["armed"] for e in eng.replay._seen.values()
+                     if e.get("armed")]
+            assert armed and armed[0].algo_sig[-1] == "none"
+            eng.config.compression = "int8"
+            eng.step_begin()
+            hvd.grouped_allreduce(list(tensors), name="cc.9", op=hvd.Sum)
+            eng.step_end()
+            rearmed = [e["armed"] for e in eng.replay._seen.values()
+                       if e.get("armed")]
+            assert rearmed and rearmed[0].algo_sig[-1] == "int8"
+        finally:
+            (eng.config.step_replay_warmup,
+             eng.config.compression) = prev
+            eng.replay.invalidate_all("test isolation")
+
+
+class TestConfigAndAutotune:
+    def test_knob_parses(self, monkeypatch):
+        from horovod_tpu.common.env import Config, HOROVOD_TPU_COMPRESSION
+        monkeypatch.setenv(HOROVOD_TPU_COMPRESSION, "int8")
+        assert Config.from_env().compression == "int8"
+        monkeypatch.setenv(HOROVOD_TPU_COMPRESSION, "bogus")
+        assert Config.from_env().compression == "none"
+        monkeypatch.delenv(HOROVOD_TPU_COMPRESSION)
+        assert Config.from_env().compression == "none"
+
+    def test_pm_step_maps_compression_categorical(self):
+        hvd.init()
+        eng = hvd._engine()
+        prev = eng.config.compression
+
+        class FakePM:
+            active = False
+            fusion_threshold_bytes = eng.config.fusion_threshold_bytes
+            cycle_time_ms = eng.config.cycle_time_ms
+
+            def tunes(self, knob):
+                return knob == "compression"
+
+            def categorical_value(self, knob):
+                return self.val
+
+        pm = FakePM()
+        eng.parameter_manager = pm
+        try:
+            eng._codec_base = "int8"
+            pm.val = False
+            eng._pm_step(0)
+            assert eng.config.compression == "none"
+            pm.val = True
+            eng._pm_step(0)
+            assert eng.config.compression == "int8"
+        finally:
+            eng.parameter_manager = None
+            eng.config.compression = prev

@@ -44,6 +44,12 @@ SECTIONS = [
     ("Optimizers & compression", "horovod_tpu", [
         "DistributedOptimizer", "DistributedDeltaAdasumOptimizer",
         "Compression"]),
+    ("Gradient wire codecs", "horovod_tpu.ops.compression", [
+        "resolve_codec", "wire_itemsize", "encode", "decode", "decode_sum",
+        "ef_encode", "FP8Compressor", "Int8Compressor"]),
+    ("", "horovod_tpu.ops.collectives", [
+        "build_codec_allreduce", "codec_residual_elems", "ef_allreduce_p",
+        "replay_residual_layout"]),
     ("Functional optimizer API", "horovod_tpu.optimizer", [
         "distributed", "DistributedState", "DistributedEagerOptimizer",
         "ShardedEagerState", "zero1_state_specs",
