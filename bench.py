@@ -767,8 +767,19 @@ def bench_busbw(sizes_bytes=None, kinds=("allreduce", "allgather"),
             busbw = factor * payload / dt / 1e9
             out[f"busbw_{band}"] = round(busbw, 3)
             out[f"busbw_{band}_spread_pct"] = round(spread, 1)
-            out[f"busbw_roofline_{band}"] = round(
-                topo.roofline_busbw_gbps(kind, algo), 3)
+            roof = topo.roofline_busbw_gbps(kind, algo)
+            out[f"busbw_roofline_{band}"] = round(roof, 3)
+            if roof and roof != float("inf"):
+                # the measured-vs-nominal delta, explicit per band
+                # (ISSUE 14: the calibration story is only credible if
+                # the gap between the nominal table and the measured
+                # fabric is a first-class number in every BENCH round)
+                out.setdefault("busbw_measured_vs_nominal_pct", {})[
+                    band] = round(100.0 * (busbw - roof) / roof, 1)
+            # raw band timings feed the same α–β fit the engine's
+            # init-time calibration runs (autotune/calibration.py)
+            out.setdefault("_fit_points", {}).setdefault(
+                (kind, algo), []).append((payload, dt))
             if kind != "allreduce":
                 continue
             # per-codec effective-bandwidth bands (ISSUE 13): the same
@@ -811,9 +822,70 @@ def bench_busbw(sizes_bytes=None, kinds=("allreduce", "allgather"),
         first = next(iter(gains))
         out["effective_busbw_gain_pct"] = round(
             sum(gains[first]) / len(gains[first]), 1)
+    # α–β fit of the sweep itself (ISSUE 14): the same model the engine's
+    # init-time probe fits, here over the bench bands — per-launch
+    # latency and measured bandwidth per (kind, selected algo) class
+    from horovod_tpu.autotune.calibration import fit_alpha_beta
+    fit_points = out.pop("_fit_points", {})
+    link_fit = {}
+    for (kind, algo), pts in sorted(fit_points.items()):
+        if len(pts) < 2:
+            continue
+        alpha, beta = fit_alpha_beta([p for p, _ in pts],
+                                     [t for _, t in pts])
+        link_fit[f"{kind}_{algo}"] = {
+            "alpha_us": round(alpha * 1e6, 1),
+            "beta_gbps": round(beta / 1e9, 3)
+            if beta != float("inf") else None}
+    if link_fit:
+        out["calibrated_link_fit"] = link_fit
     out["collective_algo_selected"] = selected
     out["busbw_escalations"] = total_escalations
     out["busbw_timing"] = f"median_of_3_spans_x{iters}_iters"
+    return out
+
+
+def knob_provenance_report():
+    """Per-knob provenance + the link table the run used (ISSUE 14 bench
+    satellite): every BENCH round records whether each tuning-relevant
+    knob value came from the environment, a default, the calibration
+    overlay, or the live autotuner — and which (nominal or measured)
+    bandwidths selection was reading — so rounds are self-describing."""
+    from horovod_tpu.common.env import Config
+    from horovod_tpu.core.state import global_state
+    st = global_state()
+    cfg = st.config if st.config is not None else Config.from_env()
+    prov = dict(cfg.provenance)
+    knobs = {}
+    for field in sorted(set(list(cfg._PROVENANCE_VARS)
+                            + ["hier_threshold_bytes"])):
+        knobs[field] = {"value": getattr(cfg, field, None),
+                        "source": prov.get(field, "default")}
+    out = {"knob_provenance": knobs}
+    pm = st.parameter_manager
+    if pm is not None:
+        out["autotune_state"] = {
+            "active": pm.active,
+            "samples": pm.n_samples_taken,
+            "warm_start": pm.warm_start_kind,
+            "knobs": pm.knob_values(),
+        }
+    eng = st.engine
+    if eng is not None:
+        topo = eng.topology
+        table = {"calibrated": topo.calibrated,
+                 "ici_gbps": topo.ici_gbps, "dcn_gbps": topo.dcn_gbps}
+        if topo.calibrated:
+            table["nominal_ici_gbps"] = topo.nominal_ici_gbps
+            table["nominal_dcn_gbps"] = topo.nominal_dcn_gbps
+            table["launch_latency_us"] = round(topo.launch_latency_us, 2)
+            table["measured_vs_nominal_ici_pct"] = round(
+                100.0 * (topo.ici_gbps - topo.nominal_ici_gbps)
+                / max(topo.nominal_ici_gbps, 1e-9), 1)
+            table["measured_vs_nominal_dcn_pct"] = round(
+                100.0 * (topo.dcn_gbps - topo.nominal_dcn_gbps)
+                / max(topo.nominal_dcn_gbps, 1e-9), 1)
+        out["link_table"] = table
     return out
 
 
@@ -1396,6 +1468,13 @@ def main():
     except Exception as e:
         busbw = {"busbw_error": f"{type(e).__name__}: {e}"}
 
+    # knob provenance (ISSUE 14): which knobs were env-forced / default /
+    # calibrated / tuned, and the link table selection was reading
+    try:
+        provenance = knob_provenance_report()
+    except Exception as e:
+        provenance = {"provenance_error": f"{type(e).__name__}: {e}"}
+
     print(json.dumps({
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
         "value": round(img_s_chip, 2),
@@ -1422,6 +1501,7 @@ def main():
         "pipeline_bubble_detail": bubble,
         **ckpt,
         **busbw,
+        **provenance,
         "spmd_spread_pct": round(spmd_spread, 1),
         "achieved_tflops_per_chip": round(tflops_chip, 2),
         "mfu_pct": (round(100.0 * tflops_chip / peak, 2)

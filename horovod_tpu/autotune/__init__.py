@@ -1,12 +1,16 @@
 """Bayesian autotuning of runtime knobs.
 
 Parity: reference ``horovod/common/parameter_manager.{h,cc}`` +
-``horovod/common/optim/`` (Gaussian process + expected improvement).
+``horovod/common/optim/`` (Gaussian process + expected improvement),
+extended (ISSUE 14) with measured-on-pod link calibration
+(:mod:`.calibration`) and tuning-record persistence keyed by
+(model signature, topology digest) (:mod:`.persistence`).
 """
 
 from .gaussian_process import GaussianProcessRegressor
 from .bayesian_optimization import BayesianOptimizer, expected_improvement
 from .parameter_manager import ParameterManager
+from .persistence import TuningStore
 
 __all__ = ["GaussianProcessRegressor", "BayesianOptimizer",
-           "expected_improvement", "ParameterManager"]
+           "expected_improvement", "ParameterManager", "TuningStore"]

@@ -3,13 +3,20 @@
 Parity: reference ``horovod/common/optim/bayesian_optimization.{h,cc}``
 (expected-improvement acquisition over a GP posterior, maximized with LBFGS
 restarts; here maximized over dense random candidates — the search space is
-2-4 dims and tiny, so candidate sampling is both simpler and as effective).
+small, so candidate sampling is both simpler and as effective).
+
+Mixed spaces (ISSUE 14): the joint knob space is numeric dims plus
+categorical dims encoded as [0, 1] partitioned evenly over k choices.
+``categorical_slots`` tells the optimizer which dims those are — every
+suggested candidate is SNAPPED to its slot centers, so the acquisition
+never spends expected improvement differentiating two points that decode
+to the same knob vector, and every suggestion is exactly representable.
 """
 
 from __future__ import annotations
 
 import math
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -43,11 +50,15 @@ class BayesianOptimizer:
 
     def __init__(self, bounds: Sequence[Tuple[float, float]],
                  n_candidates: int = 2000, xi: float = 0.01,
-                 seed: int = 0, noise: float = 1e-6):
+                 seed: int = 0, noise: float = 1e-6,
+                 categorical_slots: Optional[Dict[int, int]] = None):
         self.bounds = np.asarray(bounds, dtype=np.float64)  # (d, 2)
         self.dim = len(self.bounds)
         self.n_candidates = n_candidates
         self.xi = xi
+        # dim index -> number of choice slots; those dims must be
+        # [0, 1]-bounded (the even-partition categorical encoding)
+        self.categorical_slots = dict(categorical_slots or {})
         self._rng = np.random.RandomState(seed)
         self._xs: List[np.ndarray] = []
         self._ys: List[float] = []
@@ -79,11 +90,22 @@ class BayesianOptimizer:
         lo, hi = self.bounds[:, 0], self.bounds[:, 1]
         return lo + u * (hi - lo)
 
+    def _snap_categoricals(self, cand: np.ndarray) -> np.ndarray:
+        """Snap categorical dims (normalized coords) onto their slot
+        centers ``(idx + 0.5)/k`` — the only points that decode to a
+        choice — collapsing within-slot variation the acquisition would
+        otherwise waste candidates on."""
+        for d, k in self.categorical_slots.items():
+            idx = np.clip(np.floor(cand[..., d] * k), 0, k - 1)
+            cand[..., d] = (idx + 0.5) / k
+        return cand
+
     def suggest(self) -> np.ndarray:
         """Next point to evaluate: EI-argmax over random candidates (plus the
         incumbent's neighborhood); random until 3 samples exist."""
         if self.n_samples < 3:
-            return self._denormalize(self._rng.rand(self.dim))
+            return self._denormalize(self._snap_categoricals(
+                self._rng.rand(self.dim)))
         xs = self._normalize(np.stack(self._xs))
         ys = np.asarray(self._ys)
         # normalize scores for GP conditioning
@@ -93,7 +115,7 @@ class BayesianOptimizer:
         # local perturbations of the incumbent sharpen the search
         best_u = xs[int(np.argmax(ys))]
         local = np.clip(best_u + 0.05 * self._rng.randn(200, self.dim), 0, 1)
-        cand = np.vstack([cand, local])
+        cand = self._snap_categoricals(np.vstack([cand, local]))
         mean, std = self._gp.predict(cand)
         ei = expected_improvement(mean, std, float(((ys.max() - y_mean) /
                                                     y_std)), self.xi)

@@ -1,0 +1,370 @@
+"""Measured performance model: init-time link calibration (ISSUE 14).
+
+The selection layer (PR 10) ships *nominal* per-generation link tables
+and a fixed 256 KiB tree threshold. This module closes the loop: at
+engine init — a rank-collective point every rank reaches before any
+training collective — a short ``bench_busbw``-style probe times
+single-bucket grouped allreduces over 3–4 message bands per available
+algorithm class (flat always; tree on power-of-2 worlds >= 4;
+hierarchical when the homogeneity agreement holds), fits each class to
+the classic α–β cost model
+
+    T(S) = α + S / β        (α per-launch latency, β link bandwidth)
+
+by least squares, and overlays the fitted table on the frozen
+:class:`~..parallel.mesh.Topology` as a
+:class:`~..parallel.mesh.MeasuredTopology`. The ring/tree and
+flat/hierarchical crossover thresholds are then DERIVED from the fitted
+model instead of the fixed ``HOROVOD_TPU_TREE_THRESHOLD_BYTES``
+constant.
+
+Determinism contract (divcheck's lockstep-submission invariant): probe
+wall-clocks are rank-local, so the raw per-band medians are exchanged
+through the engine's ``_exchange_sizes`` agreement path (the
+``_hierarchical_ok()`` pattern) and every rank fits the model from the
+element-wise cross-rank median — the fit input is bit-identical
+everywhere, so the derived thresholds and every later selection are too.
+
+Nominal tables remain the fallback: probing is off by default
+(``HOROVOD_TPU_CALIBRATE``), skipped on size<=1 worlds, and probe
+failure degrades to the nominal descriptor with a WARNING — rank-local
+build failures are agreed away through a go/no-go exchange before any
+probe collective (see :func:`calibrate_engine` for the exact contract),
+so calibration never desyncs or kills an engine init.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..parallel.mesh import MeasuredTopology, Topology, measured_topology
+
+_LOG = logging.getLogger("horovod_tpu.autotune")
+
+# Message bands per link class: small enough that the whole probe is a
+# fraction of one training step's wall time on any fabric, wide enough
+# (64x) that the α and β terms are both observable in the fit.
+PROBE_BANDS_BYTES = (64 * 1024, 512 * 1024, 4 * 1024 * 1024)
+PROBE_ITERS = 3
+# Exchange grid: timings ride the int32 _exchange_sizes vector in
+# nanoseconds, capped so one band can never overflow the lane.
+_NS_CAP = 2 ** 31 - 1
+
+# Derived-threshold clamps: a fit degenerate enough to put the tree
+# crossover above ring-always or below one cache line is noise, not
+# physics.
+TREE_THRESHOLD_MIN = 4 * 1024
+TREE_THRESHOLD_MAX = 16 * 1024 * 1024
+HIER_THRESHOLD_MAX = 64 * 1024 * 1024
+
+
+def fit_alpha_beta(sizes_bytes: Sequence[float],
+                   times_s: Sequence[float]) -> Tuple[float, float]:
+    """Least-squares fit of ``T(S) = alpha + S/beta`` → ``(alpha_s,
+    beta_bytes_per_s)``. A non-positive fitted slope (pure noise on tiny
+    worlds) degrades to alpha = min(T), beta = inf-like so the bandwidth
+    term drops out instead of going negative."""
+    s = np.asarray(sizes_bytes, dtype=np.float64)
+    t = np.asarray(times_s, dtype=np.float64)
+    if len(s) < 2:
+        return (float(t[0]) if len(t) else 0.0, float("inf"))
+    slope, intercept = np.polyfit(s, t, 1)
+    alpha = max(float(intercept), 0.0)
+    if slope <= 0.0:
+        return (max(float(t.min()), 0.0), float("inf"))
+    return (alpha, 1.0 / float(slope))
+
+
+def derived_tree_threshold_bytes(alpha_s: float, beta_bytes_per_s: float,
+                                 n: int) -> int:
+    """The ring/tree crossover from the fitted α–β model.
+
+    Per-launch cost model of the two lowerings on an n-rank world:
+
+    - flat ring:          T_ring(S) = 2(n-1)·α + (2(n-1)/n)·S/β
+    - tree (recursive
+      doubling):          T_tree(S) = log2(n)·α + log2(n)·S/β
+
+    Tree is latency-optimal (log2 n launches vs 2(n-1)) but moves the
+    full payload every round; solving T_tree = T_ring for S gives the
+    byte size below which the launch savings beat the extra movement:
+
+        S* = α·β·(2(n-1) − log2 n) / (log2 n − 2(n-1)/n)
+
+    The denominator is positive for n >= 4 (exactly the worlds auto
+    selection offers tree on). Clamped to [TREE_THRESHOLD_MIN,
+    TREE_THRESHOLD_MAX]; the nominal 256 KiB default sits inside the
+    band this yields for typical dispatch latencies."""
+    if n < 4 or not math.isfinite(beta_bytes_per_s):
+        return TREE_THRESHOLD_MIN
+    log2n = math.log2(n)
+    denom = log2n - 2.0 * (n - 1) / n
+    if denom <= 0:
+        return TREE_THRESHOLD_MIN
+    s_star = alpha_s * beta_bytes_per_s * (2.0 * (n - 1) - log2n) / denom
+    return int(min(max(s_star, TREE_THRESHOLD_MIN), TREE_THRESHOLD_MAX))
+
+
+def derived_hier_threshold_bytes(flat: Tuple[float, float],
+                                 hier: Tuple[float, float]) -> int:
+    """The flat/hierarchical crossover from the two fitted (α, β) pairs.
+
+    The ladder's extra legs cost launches (α_hier > α_flat) and pay in
+    bandwidth (β_hier > β_flat on DCN-paced fabrics); the crossover is
+    where the bandwidth saving covers the latency overhead:
+
+        S* = (α_hier − α_flat) / (1/β_flat − 1/β_hier)
+
+    0 when the ladder is never slower (α_hier <= α_flat), "never" —
+    clamped to HIER_THRESHOLD_MAX — when it measured no bandwidth win
+    (so selection keeps the flat ring for every realistic bucket)."""
+    a_f, b_f = flat
+    a_h, b_h = hier
+    if a_h <= a_f:
+        return 0
+    inv_gain = (1.0 / b_f if math.isfinite(b_f) else 0.0) - \
+               (1.0 / b_h if math.isfinite(b_h) else 0.0)
+    if inv_gain <= 0:
+        return HIER_THRESHOLD_MAX
+    return int(min((a_h - a_f) / inv_gain, HIER_THRESHOLD_MAX))
+
+
+def _busbw_factor(kind: str, n: int) -> float:
+    """nccl-tests busbw convention (bench.bench_busbw)."""
+    if kind == "allgather":
+        return (n - 1) / n
+    return 2.0 * (n - 1) / n
+
+
+def _probe_classes(topology: Topology, hier_ok: bool) -> List[str]:
+    """Algorithm classes worth probing on this world, in a fixed order
+    (the exchange vector's layout — every rank must build the same)."""
+    from ..ops import collectives as C
+    classes = [C.ALGO_FLAT]
+    n = topology.size
+    if n >= 4 and (n & (n - 1)) == 0:
+        classes.append(C.ALGO_TREE)
+    if hier_ok:
+        classes.append(C.ALGO_HIERARCHICAL)
+    return classes
+
+
+def _time_probe(run, iters: int = PROBE_ITERS) -> float:
+    """Median of ``iters`` timed executions of one pre-compiled probe
+    program (the bench's quietest-reading discipline, scaled down to
+    init-time cost)."""
+    import jax
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run())
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    return samples[len(samples) // 2]
+
+
+def build_probes(engine, bands: Sequence[int] = PROBE_BANDS_BYTES
+                 ) -> List[Tuple[str, int, "object"]]:
+    """Construct every (algorithm class, band) probe program + input
+    buffer WITHOUT issuing a collective: all the rank-locally-fallible
+    work (buffer allocation, program construction) happens here, so a
+    failure on one rank can be agreed away through the go/no-go exchange
+    in :func:`calibrate_engine` before any rank enters a probe
+    collective. Returns ``[(algo, band_bytes, run), ...]`` in the fixed
+    (class, band) order every rank shares."""
+    import jax.numpy as jnp
+    from ..common.reduce_ops import ReduceOp
+    from ..ops import collectives as C
+
+    topo = engine.topology
+    mesh = engine.backend.group_mesh
+    n = topo.size
+    probes: List[Tuple[str, int, object]] = []
+    for algo in _probe_classes(topo, engine._hierarchical_ok()):
+        for size in bands:
+            elems = max(size // 4, n)
+            fn = C.build_grouped_allreduce(
+                mesh, "world", ReduceOp.SUM, ((elems,),), [jnp.float32],
+                [[0]], local_size=topo.local_size, algos=(algo,))
+            arr = engine.backend.to_global(
+                np.zeros((elems,), dtype=np.float32))
+            probes.append((algo, size,
+                           lambda fn=fn, arr=arr: fn(arr)[0]))
+    return probes
+
+
+def probe_link_times(engine, bands: Sequence[int] = PROBE_BANDS_BYTES,
+                     probes: Optional[List[Tuple[str, int, object]]] = None
+                     ) -> Dict[str, List[float]]:
+    """Run the rank-collective probe: for every (algorithm class, band)
+    time a single-bucket grouped allreduce of that size built exactly the
+    way the engine builds training buckets. Returns rank-LOCAL medians —
+    callers must push them through :func:`agree_times` before fitting.
+    Every rank iterates classes and bands in the same order, so the
+    collectives inside stay in lockstep."""
+    if probes is None:
+        probes = build_probes(engine, bands)
+    out: Dict[str, List[float]] = {}
+    for algo, _size, run in probes:
+        run()   # compile outside the timed span
+        out.setdefault(algo, []).append(_time_probe(run))
+    return out
+
+
+def agree_times(engine, local_times: Dict[str, List[float]]
+                ) -> Dict[str, List[float]]:
+    """Exchange rank-local probe medians through the engine's agreement
+    path and return the element-wise cross-rank MEDIAN — identical on
+    every rank (the fit input every rank derives thresholds from).
+    Single-rank worlds pass through unchanged."""
+    if engine.backend.size() <= 1:
+        return local_times
+    keys = sorted(local_times)
+    flat = [min(int(t * 1e9), _NS_CAP)
+            for k in keys for t in local_times[k]]
+    vec = np.asarray(flat, dtype=np.int32)
+    world = engine._exchange_sizes(vec)         # (size, len(flat))
+    agreed_ns = np.median(np.asarray(world, dtype=np.float64), axis=0)
+    out: Dict[str, List[float]] = {}
+    i = 0
+    for k in keys:
+        width = len(local_times[k])
+        out[k] = [max(float(v) / 1e9, 1e-9)
+                  for v in agreed_ns[i:i + width]]
+        i += width
+    return out
+
+
+def fit_measured_topology(topology: Topology,
+                          agreed: Dict[str, List[float]],
+                          bands: Sequence[int] = PROBE_BANDS_BYTES
+                          ) -> MeasuredTopology:
+    """Fit the agreed per-class timings into a
+    :class:`~..parallel.mesh.MeasuredTopology`.
+
+    Link inversion: the flat ring's fitted β, normalized by the busbw
+    factor, measures the fabric the ring is paced by — DCN on multislice
+    worlds, ICI otherwise. On multislice worlds the hierarchical ladder's
+    β then bounds ICI from below (ladder busbw = min(ici, dcn·local), so
+    when the ladder beat dcn·local the ICI estimate is the ladder figure,
+    else ICI is unresolved and keeps the nominal ICI:DCN ratio applied to
+    the measured DCN)."""
+    from ..ops import collectives as C
+
+    n = topology.size
+    fitted = {algo: fit_alpha_beta(bands, times)
+              for algo, times in agreed.items()}
+    flat_alpha, flat_beta = fitted[C.ALGO_FLAT]
+    flat_busbw = _busbw_factor("allreduce", n) * flat_beta
+    ratio = topology.ici_gbps / max(topology.dcn_gbps, 1e-9)
+    if topology.is_multislice:
+        dcn_gbps = flat_busbw / 1e9
+        ici_gbps = dcn_gbps * ratio
+        hier_fit = fitted.get(C.ALGO_HIERARCHICAL)
+        if hier_fit is not None:
+            hier_busbw = _busbw_factor("allreduce", n) * hier_fit[1] / 1e9
+            if hier_busbw < dcn_gbps * topology.local_size * 0.95:
+                ici_gbps = max(hier_busbw, dcn_gbps)
+    else:
+        ici_gbps = flat_busbw / 1e9
+        dcn_gbps = ici_gbps / max(ratio, 1e-9)
+    # per-launch latency: the flat fit's α spread over the ring's launch
+    # count — the per-hop dispatch figure the threshold model uses
+    launch_latency_us = flat_alpha / max(2 * (n - 1), 1) * 1e6
+    return measured_topology(topology, ici_gbps=ici_gbps,
+                             dcn_gbps=dcn_gbps,
+                             launch_latency_us=launch_latency_us,
+                             link_model=fitted)
+
+
+def derived_thresholds(measured: MeasuredTopology) -> Tuple[int, int]:
+    """(tree_threshold_bytes, hier_threshold_bytes) from the fitted
+    model. hier_threshold is 0 (always-hierarchical, the nominal
+    behavior) when the ladder was not probed."""
+    from ..ops import collectives as C
+    n = measured.size
+    flat = measured.fitted(C.ALGO_FLAT)
+    tree = measured.fitted(C.ALGO_TREE)
+    if tree is not None and flat is not None:
+        # both lowerings measured: solve the crossover directly from the
+        # two fits (the model solved symbolically in
+        # derived_tree_threshold_bytes, with measured per-class α/β)
+        a_t, b_t = tree
+        a_f, b_f = flat
+        inv = (1.0 / b_t if math.isfinite(b_t) else 0.0) - \
+              (1.0 / b_f if math.isfinite(b_f) else 0.0)
+        if a_f > a_t and inv > 0:
+            s_star = (a_f - a_t) / inv
+            tree_thr = int(min(max(s_star, TREE_THRESHOLD_MIN),
+                               TREE_THRESHOLD_MAX))
+        elif a_f > a_t:
+            tree_thr = TREE_THRESHOLD_MAX   # tree never slower in-band
+        else:
+            tree_thr = TREE_THRESHOLD_MIN
+    elif flat is not None:
+        tree_thr = derived_tree_threshold_bytes(
+            flat[0] / max(2 * (n - 1), 1), flat[1], n)
+    else:
+        tree_thr = TREE_THRESHOLD_MIN
+    hier = measured.fitted(C.ALGO_HIERARCHICAL)
+    hier_thr = (derived_hier_threshold_bytes(flat, hier)
+                if flat is not None and hier is not None else 0)
+    return tree_thr, hier_thr
+
+
+def calibrate_engine(engine) -> Optional[MeasuredTopology]:
+    """The whole init-time loop: build → go/no-go agree → probe → agree
+    → fit → derive. Returns the measured descriptor (the caller installs
+    it and the derived thresholds), or None when the world cannot be
+    probed.
+
+    Fallback contract: the rank-locally-fallible work (buffer
+    allocation, program construction) runs BEFORE any collective and its
+    outcome is agreed through the same exchange path the probe medians
+    ride — one rank failing to build degrades EVERY rank to the nominal
+    tables in lockstep, never a desync. Failures past that point are
+    either world-uniform (compile errors, fit math — every rank takes
+    the same except branch) or genuine collective failures, which
+    surface through the backend's normal failure translation exactly
+    like a training-step collective would — not a silent hang."""
+    topo = engine.topology
+    if topo.size <= 1 or engine.backend.group_mesh is None:
+        return None
+    try:
+        probes = build_probes(engine)
+        ok = 1
+    except Exception as e:   # rank-local: agree it away below
+        _LOG.warning("link-probe construction failed (%s: %s)",
+                     type(e).__name__, e)
+        probes, ok = [], 0
+    try:
+        agreed_ok = np.asarray(engine._exchange_sizes(
+            np.asarray([ok], dtype=np.int32)))
+        if int(agreed_ok.min()) == 0:
+            if ok:
+                _LOG.warning("a peer rank could not build the link "
+                             "probe; keeping the nominal link tables "
+                             "on every rank")
+            return None
+        t0 = time.perf_counter()
+        local = probe_link_times(engine, probes=probes)
+        agreed = agree_times(engine, local)
+        measured = fit_measured_topology(topo, agreed)
+        _LOG.info(
+            "link calibration: %d classes x %d bands in %.0f ms — "
+            "ici %.2f GB/s (nominal %.1f), dcn %.2f GB/s (nominal "
+            "%.1f), launch latency %.1f us",
+            len(agreed), len(PROBE_BANDS_BYTES),
+            (time.perf_counter() - t0) * 1e3, measured.ici_gbps,
+            measured.nominal_ici_gbps, measured.dcn_gbps,
+            measured.nominal_dcn_gbps, measured.launch_latency_us)
+        return measured
+    except Exception as e:  # calibration must never kill an engine init
+        _LOG.warning("link calibration failed (%s: %s); keeping the "
+                     "nominal link tables", type(e).__name__, e)
+        return None

@@ -10,48 +10,51 @@ winning parameters are broadcast from rank 0 so every worker agrees
 identical on every rank (SPMD), but we keep the broadcast for the eager path
 where ranks may measure slightly different wall-clock.
 
-Tuned knobs (log₂-scaled, like the reference's NumericParameter scaling):
-- fusion_threshold_bytes ∈ [1 MB, 256 MB]
-- cycle_time_ms ∈ [1, 25]
+The search space (ISSUE 14: one JOINT space, not one-knob sweeps):
 
-Categorical knobs (parameter_manager.h:225-228 tunes hierarchical
-allreduce/allgather and cache enablement the same way): each enabled
-categorical is one [0, 1] GP dimension, thresholded at 0.5 when read —
-the topology-dependent on/off choices (hierarchical ladders, Pallas
-packing) that a static default cannot make per cluster:
-- hierarchical_allreduce / hierarchical_allgather (offered when
-  local_size > 1)
-- pallas_pack (offered when Pallas is available)
-- single_launch (one-vs-two-dispatch grouped allreduce; the best choice
-  depends on dispatch overhead vs pack-fusion quality per runtime)
-- step_replay (step-capture replay, core/replay.py: whether fusing the
-  whole steady-state step into one launch beats the grouped path is a
-  per-runtime dispatch-overhead fact, so it tunes like the other
-  topology-dependent on/off choices)
-- shard_optimizer (ZeRO-1 optimizer-state partitioning, optimizer.py:
-  reduce-scatter + shard-local update + allgather vs allreduce +
-  replicated update — the win depends on model size vs interconnect
-  latency; the knob only steers optimizers whose state is created after
-  the flip, since live shard shapes are frozen at init)
-- overlap_pipeline (ISSUE 6 bucket-pipelined comm/compute overlap:
-  serial vs pipelined collective schedule inside the fused step —
-  engine._pm_step maps the boolean onto the "off"/base string knob;
-  whether the pipelined schedule or the extra staged sub-launches pay
-  is a per-runtime dispatch-overhead-vs-wire-time fact, the same trade
-  step_replay tunes)
+- numeric dims, log₂-scaled like the reference's NumericParameter scaling:
+  fusion_threshold_bytes ∈ [1 MB, 256 MB], cycle_time_ms ∈ [1, 25], and —
+  when the tree threshold is offered (``tune_tree_threshold``) —
+  tree_threshold_bytes ∈ [4 KiB, 16 MiB];
+- categorical dims (parameter_manager.h:225-228 tunes hierarchical
+  allreduce/allgather and cache enablement the same way): each is one
+  [0, 1] GP dimension partitioned evenly over its choices. A categorical
+  declared as a bare name keeps the legacy boolean form (choices
+  ``(False, True)``, thresholded at 0.5); declared as ``(name, choices)``
+  it is string-valued — ``collective_algo`` explores
+  flat/tree/hierarchical/auto directly and ``compression`` explores
+  codecs, instead of the boolean-over-string encoding PR 10 noted.
+
+Seeding and persistence (ISSUE 14): ``seed_suggestions`` are tried before
+the GP's random exploration phase — the calibrated link model's predicted
+winners go first, so the tuner starts from measurement rather than cold
+priors. A :class:`~.persistence.TuningStore` attached via
+``attach_persistence`` warm-starts the search from a stored record keyed
+by (model signature, topology digest): an EXACT key match adopts the
+stored winner immediately and converges after one confirmation sample; a
+nearest-key match (elastic N→M resize) seeds the search from the stored
+winner but re-tunes, since scores from a different world size are not
+comparable. Converged settings flow back out through ``on_converged``.
 
 Scoring: the interval between successive ``step_mark`` calls spans one
 full training step (mark fires at grouped-allreduce entry each step), so
 score = bytes/interval is end-to-end step throughput, not
 collective-only time — a knob that speeds the collective but slows the
 step scores worse.
+
+Thread model: all tuning state (the knob vector, the GP, warm-start and
+persistence hooks) is confined to the dispatch thread — step_mark /
+maybe_warm_start run from the engine's submission path and the
+convergence save runs inline at a sample boundary. ``close()`` from the
+shutdown path only touches the log-file handle. No locks by design (the
+replay-module confinement discipline, docs/static_analysis.md).
 """
 
 from __future__ import annotations
 
 import logging
 import time
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -62,11 +65,18 @@ _LOG = logging.getLogger("horovod_tpu.autotune")
 
 MB = 1024 * 1024
 
+# persisted observations re-registered on an exact warm start are capped:
+# the GP conditions on them in O(n^3) and anything beyond the original
+# sample budget adds nothing
+WARM_OBSERVATIONS_MAX = 64
+
 
 class ParameterManager:
     WARMUPS = 3            # HOROVOD_AUTOTUNE_WARMUP_SAMPLES default (h:234)
     CYCLES_PER_SAMPLE = 10  # steps averaged per candidate (h:238)
     MAX_SAMPLES = 20       # BAYES_OPT_MAX_SAMPLES: stop tuning after this
+
+    TREE_THRESHOLD_BOUNDS = (4 * 1024, 16 * MB)
 
     def __init__(self, warmup_samples: int = WARMUPS,
                  steps_per_sample: int = CYCLES_PER_SAMPLE,
@@ -76,25 +86,66 @@ class ParameterManager:
                  initial_cycle_ms: float = 5.0,
                  log_path: Optional[str] = None,
                  bcast_object: Optional[Callable] = None,
-                 categorical: Optional[List[str]] = None,
-                 categorical_initial: Optional[dict] = None):
-        # search space: 2 numeric dims in log2 units + one [0,1] dim per
+                 categorical: Optional[Sequence[
+                     Union[str, Tuple[str, Sequence]]]] = None,
+                 categorical_initial: Optional[dict] = None,
+                 tune_tree_threshold: bool = False,
+                 initial_tree_threshold: int = 256 * 1024,
+                 seed_suggestions: Optional[Sequence] = None):
+        # search space: numeric dims in log2 units + one [0,1] dim per
         # categorical knob (parameter_manager.h:225-228)
-        self._categorical = list(categorical or [])
+        self._categorical: List[str] = []
+        self._choices: dict = {}
+        for entry in (categorical or []):
+            if isinstance(entry, str):
+                name, choices = entry, (False, True)
+            else:
+                name, choices = entry[0], tuple(entry[1])
+                if len(choices) < 2:
+                    raise ValueError(
+                        f"categorical {name!r} needs >= 2 choices")
+            self._categorical.append(name)
+            self._choices[name] = choices
+        self._numeric = ["fusion_threshold_bytes", "cycle_time_ms"]
         self._bounds = [(np.log2(1 * MB), np.log2(256 * MB)),
                         (np.log2(1.0), np.log2(25.0))]
+        self._tune_tree = bool(tune_tree_threshold)
+        if self._tune_tree:
+            self._numeric.append("tree_threshold_bytes")
+            lo, hi = self.TREE_THRESHOLD_BOUNDS
+            self._bounds.append((np.log2(lo), np.log2(hi)))
+        self._cat_offset = len(self._numeric)
         self._bounds += [(0.0, 1.0)] * len(self._categorical)
-        self._opt = BayesianOptimizer(self._bounds, noise=gp_noise)
+        self._opt = BayesianOptimizer(
+            self._bounds, noise=gp_noise,
+            categorical_slots={
+                self._cat_offset + i: len(self._choices[name])
+                for i, name in enumerate(self._categorical)})
         self._warmup_remaining = warmup_samples
         self._steps_per_sample = steps_per_sample
         self._max_samples = max_samples
         self._bcast_object = bcast_object
+        # calibrated-model predictions tried before random exploration
+        self._seed_suggestions: List[np.ndarray] = [
+            np.asarray(s, dtype=np.float64)
+            for s in (seed_suggestions or [])]
+        # persistence (attach_persistence): record store + convergence sink
+        self._store = None
+        self._on_converged: Optional[Callable[[dict], None]] = None
+        self._warm_attempted = False
+        self._warm_kind = "none"     # "none" | "exact" | "nearest"
+        self._model_sig: Optional[str] = None
 
         self._active = True
-        init_cat = [1.0 if (categorical_initial or {}).get(name) else 0.0
+        init_vals = [np.log2(initial_threshold), np.log2(initial_cycle_ms)]
+        if self._tune_tree:
+            lo, hi = self.TREE_THRESHOLD_BOUNDS
+            init_vals.append(np.log2(
+                min(max(int(initial_tree_threshold), lo), hi)))
+        init_cat = [self._encode_choice(name,
+                                        (categorical_initial or {}).get(name))
                     for name in self._categorical]
-        self._current = np.array([np.log2(initial_threshold),
-                                  np.log2(initial_cycle_ms)] + init_cat)
+        self._current = np.array(init_vals + init_cat)
         self._scores: List[float] = []
         self._step_bytes = 0
         self._step_start: Optional[float] = None
@@ -108,15 +159,17 @@ class ParameterManager:
         self._m_cycle = _reg.gauge("hvd_tpu_autotune_cycle_time_ms")
         self._m_categorical = _reg.gauge("hvd_tpu_autotune_categorical")
         self._m_active = _reg.gauge("hvd_tpu_autotune_active")
+        self._m_warm = _reg.counter("hvd_tpu_autotune_warm_starts_total")
         self._publish_metrics()
 
         self._log_path = log_path
         self._log_file = open(log_path, "w") if log_path else None
         if self._log_file:
             cat_cols = "".join(f",{c}" for c in self._categorical)
+            tree_col = ",tree_threshold_bytes" if self._tune_tree else ""
             self._log_file.write(
-                f"sample,fusion_threshold_bytes,cycle_time_ms{cat_cols}"
-                f",score_bytes_per_sec\n")
+                f"sample,fusion_threshold_bytes,cycle_time_ms{tree_col}"
+                f"{cat_cols},score_bytes_per_sec\n")
 
     # -- public knob values --------------------------------------------------
 
@@ -133,17 +186,191 @@ class ParameterManager:
         return float(2 ** self._current[1])
 
     @property
+    def tunes_tree_threshold(self) -> bool:
+        return self._tune_tree
+
+    @property
+    def tree_threshold_bytes(self) -> int:
+        """Current tuned tree threshold (only meaningful when
+        ``tunes_tree_threshold``)."""
+        if not self._tune_tree:
+            raise ValueError("tree threshold is not a tuned dimension")
+        return int(2 ** self._current[2])
+
+    @property
     def n_samples_taken(self) -> int:
         return self._opt.n_samples
+
+    @property
+    def warm_start_kind(self) -> str:
+        """"exact" / "nearest" / "none" — how this tuner was seeded from
+        the persistence tier (test + bench provenance surface)."""
+        return self._warm_kind
 
     def tunes(self, name: str) -> bool:
         """Whether ``name`` is a tuned categorical dimension."""
         return name in self._categorical
 
-    def categorical_value(self, name: str) -> bool:
-        """Current on/off value of a tuned categorical knob."""
+    def categorical_choices(self, name: str) -> tuple:
+        """The declared choice tuple of a tuned categorical knob."""
+        return self._choices[name]
+
+    def categorical_value(self, name: str):
+        """Current value of a tuned categorical knob: the chosen element
+        of its choice tuple — a bool for legacy boolean knobs (choices
+        ``(False, True)``), a string for string-valued knobs."""
         i = self._categorical.index(name)
-        return bool(self._current[2 + i] >= 0.5)
+        return self._decode_choice(name, self._current[self._cat_offset + i])
+
+    # -- choice encoding -----------------------------------------------------
+
+    def _encode_choice(self, name: str, value) -> float:
+        """Map a choice value onto the center of its slot in [0, 1];
+        unknown/missing values land on slot 0 (the legacy
+        missing-initial-means-False behavior)."""
+        choices = self._choices[name]
+        try:
+            idx = choices.index(value)
+        except ValueError:
+            idx = 0
+        return (idx + 0.5) / len(choices)
+
+    def _decode_choice(self, name: str, u: float):
+        choices = self._choices[name]
+        idx = min(int(max(float(u), 0.0) * len(choices)), len(choices) - 1)
+        return choices[idx]
+
+    def encode(self, fusion_threshold_bytes: Optional[int] = None,
+               cycle_time_ms: Optional[float] = None,
+               tree_threshold_bytes: Optional[int] = None,
+               categorical_values: Optional[dict] = None) -> np.ndarray:
+        """A knob vector in this manager's search space: the current point
+        with the given knob values substituted — how callers (the
+        calibration seeding in core/state.py, tests) phrase predictions
+        in knob units instead of GP coordinates."""
+        x = self._current.copy()
+        if fusion_threshold_bytes is not None:
+            x[0] = np.log2(max(int(fusion_threshold_bytes), 1))
+        if cycle_time_ms is not None:
+            x[1] = np.log2(max(float(cycle_time_ms), 1e-3))
+        if tree_threshold_bytes is not None and self._tune_tree:
+            lo, hi = self.TREE_THRESHOLD_BOUNDS
+            x[2] = np.log2(min(max(int(tree_threshold_bytes), lo), hi))
+        for name, value in (categorical_values or {}).items():
+            if name in self._categorical:
+                i = self._categorical.index(name)
+                x[self._cat_offset + i] = self._encode_choice(name, value)
+        return x
+
+    def space(self) -> dict:
+        """The search-space descriptor persisted with every tuning record
+        and validated on load — a record whose space does not match this
+        manager's (different dims, renamed knobs, changed choice sets)
+        is stale by definition."""
+        return {"numeric": list(self._numeric),
+                "categorical": [[name, list(self._choices[name])]
+                                for name in self._categorical]}
+
+    def knob_values(self) -> dict:
+        """Every tuned knob's current concrete value (the record payload
+        and the bench's provenance report)."""
+        out = {"fusion_threshold_bytes": self.fusion_threshold_bytes,
+               "cycle_time_ms": round(self.cycle_time_ms, 3)}
+        if self._tune_tree:
+            out["tree_threshold_bytes"] = self.tree_threshold_bytes
+        for name in self._categorical:
+            out[name] = self.categorical_value(name)
+        return out
+
+    # -- persistence / warm start (ISSUE 14) ---------------------------------
+
+    def attach_persistence(self, store,
+                           on_converged: Optional[Callable[[dict], None]]
+                           = None):
+        """Wire the tuning store: ``maybe_warm_start`` consults it at the
+        first step and the convergence record flows to ``on_converged``
+        (defaults to ``store.save``)."""
+        self._store = store
+        self._on_converged = (on_converged if on_converged is not None
+                              else getattr(store, "save", None))
+
+    def maybe_warm_start(self, model_sig: Optional[str]):
+        """One-shot warm start, deferred to the first step boundary —
+        the model signature (frozen bucket-layout digest) only exists
+        once the first grouped call has shown the engine its gradient
+        set. Rank 0 performs the store lookup; the result rides the same
+        broadcast channel as parameter sync, so every rank applies the
+        identical record (or none) in lockstep."""
+        if self._warm_attempted or not self._active or model_sig is None:
+            return
+        self._warm_attempted = True
+        self._model_sig = model_sig
+        payload = None
+        if self._store is not None and getattr(self._store, "is_root",
+                                               False):
+            try:
+                payload = self._store.lookup(model_sig, self.space())
+            except Exception as e:   # a broken record must not stop tuning
+                _LOG.warning("tuning-record lookup failed: %s", e)
+                payload = None
+        if self._bcast_object is not None:
+            payload = self._bcast_object(payload, name="autotune.warmstart")
+        if self._store is None and payload is None:
+            return
+        if payload is None:
+            self._m_warm.inc(kind="miss")
+            return
+        record, exact = payload
+        self._apply_warm_start(record, exact)
+
+    def _apply_warm_start(self, record: dict, exact: bool):
+        x = np.asarray(record.get("best_x", ()), dtype=np.float64)
+        if x.shape != self._current.shape:
+            _LOG.warning("tuning record dimensionality %s does not match "
+                         "the live search space %s; ignoring it",
+                         x.shape, self._current.shape)
+            self._m_warm.inc(kind="miss")
+            return
+        self._current = x
+        if exact:
+            # adopt the stored winner now; replay its observations into
+            # the GP so the budget check sees a finished search and the
+            # next sample is a pure confirmation pass (<= 1 cycle to
+            # steady state, the acceptance bound)
+            self._warm_kind = "exact"
+            self._warmup_remaining = 0
+            for obs in record.get("observations",
+                                  [])[-WARM_OBSERVATIONS_MAX:]:
+                try:
+                    self._opt.register(np.asarray(obs[0]), float(obs[1]))
+                except (TypeError, ValueError, IndexError):
+                    continue
+            self._m_warm.inc(kind="exact")
+            _LOG.info("autotune warm start (exact key): adopting %s",
+                      self.knob_values())
+        else:
+            # nearest key (elastic N->M resize): scores from another
+            # world size are not comparable — seed the search at the
+            # stored winner but keep exploring
+            self._warm_kind = "nearest"
+            self._seed_suggestions.insert(0, x.copy())
+            self._m_warm.inc(kind="nearest")
+            _LOG.info("autotune warm start (nearest key): re-tuning from "
+                      "%s", self.knob_values())
+        self._publish_metrics()
+
+    def _convergence_record(self, best_y: float) -> dict:
+        return {
+            "version": 1,
+            "model_sig": self._model_sig,
+            "space": self.space(),
+            "best_x": [float(v) for v in self._current],
+            "best_score": float(best_y),
+            "observations": [[[float(v) for v in x], float(y)]
+                             for x, y in zip(self._opt._xs, self._opt._ys)
+                             ][-WARM_OBSERVATIONS_MAX:],
+            "knobs": self.knob_values(),
+        }
 
     # -- scoring loop --------------------------------------------------------
 
@@ -179,9 +406,25 @@ class ParameterManager:
         self._m_threshold.set(self.fusion_threshold_bytes)
         self._m_cycle.set(self.cycle_time_ms)
         for c in self._categorical:
+            value = self.categorical_value(c)
+            # gauges are numeric: booleans as 0/1, string choices as the
+            # chosen index into the declared choice tuple
             self._m_categorical.set(
-                1.0 if self.categorical_value(c) else 0.0, name=c)
+                float(self._choices[c].index(value)), name=c)
         self._m_active.set(1.0 if self._active else 0.0)
+
+    def _log_cat_cols(self) -> str:
+        out = []
+        for c in self._categorical:
+            v = self.categorical_value(c)
+            out.append(f",{int(v)}" if isinstance(v, bool) else f",{v}")
+        return "".join(out)
+
+    def _log_numeric_cols(self) -> str:
+        cols = f"{self.fusion_threshold_bytes},{self.cycle_time_ms:.3f}"
+        if self._tune_tree:
+            cols += f",{self.tree_threshold_bytes}"
+        return cols
 
     def _on_sample(self, score: float):
         if self._warmup_remaining > 0:
@@ -190,11 +433,9 @@ class ParameterManager:
         self._opt.register(self._current.copy(), score)
         self._m_samples.inc()
         if self._log_file:
-            cats = "".join(f",{int(self.categorical_value(c))}"
-                           for c in self._categorical)
             self._log_file.write(
-                f"{self._opt.n_samples},{self.fusion_threshold_bytes},"
-                f"{self.cycle_time_ms:.3f}{cats},{score:.1f}\n")
+                f"{self._opt.n_samples},{self._log_numeric_cols()}"
+                f"{self._log_cat_cols()},{score:.1f}\n")
             self._log_file.flush()
         if self._opt.n_samples >= self._max_samples:
             best_x, best_y = self._opt.best()
@@ -208,18 +449,29 @@ class ParameterManager:
                 {c: self.categorical_value(c) for c in self._categorical},
                 best_y / MB)
             if self._log_file:
-                cats = "".join(f",{int(self.categorical_value(c))}"
-                               for c in self._categorical)
                 self._log_file.write(
-                    f"best,{self.fusion_threshold_bytes},"
-                    f"{self.cycle_time_ms:.3f}{cats},{best_y:.1f}\n")
+                    f"best,{self._log_numeric_cols()}"
+                    f"{self._log_cat_cols()},{best_y:.1f}\n")
                 self._log_file.flush()
                 self._log_file.close()
                 self._log_file = None
+            if self._on_converged is not None:
+                try:
+                    self._on_converged(self._convergence_record(best_y))
+                except Exception as e:  # persistence is best-effort
+                    _LOG.warning("tuning-record save failed: %s", e)
         else:
-            self._current = np.asarray(self._opt.suggest())
+            self._current = self._next_point()
             self._sync_params()
         self._publish_metrics()
+
+    def _next_point(self) -> np.ndarray:
+        """Next candidate: calibrated-prediction seeds first (the
+        measured model's suggestions explored before anything random),
+        then the GP's expected-improvement argmax."""
+        if self._seed_suggestions:
+            return np.asarray(self._seed_suggestions.pop(0))
+        return np.asarray(self._opt.suggest())
 
     def _sync_params(self):
         """Agree on parameters across ranks (controller.cc:34-48): rank 0's

@@ -1,0 +1,270 @@
+"""Persistent fleet autotune: tuning records keyed by (model, topology).
+
+ISSUE 14 tentpole layer 3. A converged autotune search is expensive —
+max_samples × steps_per_sample training steps spent off the optimum — and
+its result is a pure function of (what the model submits, what fabric it
+runs on). So the winning settings persist, keyed by:
+
+- **model signature** — the digest of the frozen bucket layout (the
+  gradient set's shapes/dtypes, engine.model_signature()): two jobs
+  training the same model submit identical layouts;
+- **topology digest** — the fabric SHAPE (``Topology.digest()``: size,
+  local_size, num_slices, platform), deliberately excluding measured
+  bandwidths, which vary run to run.
+
+Records are written to the tuning-record directory (default
+``<checkpoint dir>/autotune``) and, when control-plane endpoints are
+wired, published to the replicated KV under the ``autotune`` scope — a
+restarted job on a fresh host warm-starts from the KV even before any
+shared filesystem catches up.
+
+Load semantics (ParameterManager.maybe_warm_start drives this, rank 0
+only, result broadcast):
+
+- **exact** key → the stored winner is adopted immediately and the tuner
+  converges after one confirmation sample;
+- **stale** record (digest mismatch inside the payload, wrong search
+  space, wrong version) → rejected loudly, never applied — a record for
+  a different topology would install knobs whose selection the fabric
+  cannot honor;
+- **nearest** key (same model, different topology — the elastic N→M
+  resize) → the record nearest in world shape seeds the search, which
+  re-tunes: scores measured on N ranks say nothing quantitative about M.
+
+Thread model: lookup runs once on the dispatch thread at the first step
+boundary; save runs on the same thread at convergence. No concurrent
+access, no locks — single-thread confinement, the replay-module
+discipline.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import tempfile
+from typing import List, Optional, Tuple
+
+_LOG = logging.getLogger("horovod_tpu.autotune")
+
+RECORD_VERSION = 1
+KV_SCOPE = "autotune"
+_PREFIX = "tune_"
+
+
+def _topo_digest_of(topo: dict) -> str:
+    """Recompute ``Topology.digest()`` from a record's stored topology
+    payload (the integrity check the nearest-key scan applies)."""
+    import hashlib
+    text = f"{topo.get('size')}|{topo.get('local_size')}|" \
+           f"{topo.get('num_slices')}|{topo.get('platform')}"
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def record_filename(model_sig: str, topo_digest: str) -> str:
+    return f"{_PREFIX}{model_sig[:16]}_{topo_digest[:16]}.json"
+
+
+def kv_key(model_sig: str, topo_digest: str) -> str:
+    return f"{model_sig[:16]}:{topo_digest[:16]}"
+
+
+class TuningStore:
+    """File + KV persistence for converged tuning records.
+
+    ``topology`` is the live world's descriptor — its ``digest()`` is the
+    key half every load is validated against; ``kv`` is the
+    ``(addr_or_endpoints, port)`` pair the observability consumers share
+    (core/state.py), or None for file-only operation."""
+
+    def __init__(self, dir_path: Optional[str], topology, rank: int = 0,
+                 kv=None, kv_timeout: float = 5.0):
+        self.dir = dir_path
+        self.topology = topology
+        self.topo_digest = topology.digest()
+        self.rank = int(rank)
+        self.kv = kv
+        self.kv_timeout = float(kv_timeout)
+
+    @property
+    def is_root(self) -> bool:
+        return self.rank == 0
+
+    # -- save ----------------------------------------------------------------
+
+    def save(self, record: dict) -> Optional[str]:
+        """Persist one convergence record (rank 0 only — every rank holds
+        an identical record after the convergence broadcast, one writer
+        is enough). Returns the file path, or None when nothing was
+        written. Best-effort: persistence failures warn, never raise into
+        the training loop."""
+        if not self.is_root or record.get("model_sig") is None:
+            return None
+        record = dict(record)
+        record["topo_digest"] = self.topo_digest
+        record["topology"] = {
+            "size": self.topology.size,
+            "local_size": self.topology.local_size,
+            "num_slices": self.topology.num_slices,
+            "platform": self.topology.platform,
+        }
+        payload = json.dumps(record, sort_keys=True).encode()
+        path = None
+        if self.dir:
+            try:
+                os.makedirs(self.dir, exist_ok=True)
+                path = os.path.join(self.dir, record_filename(
+                    record["model_sig"], self.topo_digest))
+                # atomic publish: a concurrently-restarting reader must
+                # never see a half-written record
+                fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
+                with os.fdopen(fd, "wb") as f:
+                    f.write(payload)
+                os.replace(tmp, path)
+                _LOG.info("tuning record saved: %s", path)
+            except OSError as e:
+                _LOG.warning("tuning record write failed (%s): %s",
+                             self.dir, e)
+                path = None
+        if self.kv is not None:
+            try:
+                from ..runner.http_client import put_data_into_kvstore
+                addr, port = self.kv
+                put_data_into_kvstore(
+                    addr, port, KV_SCOPE,
+                    kv_key(record["model_sig"], self.topo_digest),
+                    payload, timeout=self.kv_timeout)
+            except Exception as e:
+                _LOG.warning("tuning record KV publish failed: %s", e)
+        return path
+
+    # -- load ----------------------------------------------------------------
+
+    def lookup(self, model_sig: str,
+               space: dict) -> Optional[Tuple[dict, bool]]:
+        """Resolve the warm-start record for ``model_sig`` on this
+        topology: ``(record, exact)`` or None. Exact beats nearest; file
+        beats KV (the KV copy is the same bytes published by the last
+        writer). Every candidate is validated — stale digests are
+        REJECTED here, loudly, not papered over."""
+        rec = self._load_exact(model_sig, space)
+        if rec is not None:
+            return rec, True
+        rec = self._load_nearest(model_sig, space)
+        if rec is not None:
+            return rec, False
+        return None
+
+    def _validate(self, record: dict, model_sig: str, space: dict,
+                  expect_topo: Optional[str], origin: str
+                  ) -> Optional[dict]:
+        """The stale-record gate: version, digests, and search space must
+        all match or the record is refused by name."""
+        if not isinstance(record, dict) or \
+                record.get("version") != RECORD_VERSION:
+            _LOG.warning("tuning record %s: unknown version %r — "
+                         "rejected", origin, record.get("version")
+                         if isinstance(record, dict) else None)
+            return None
+        if record.get("model_sig") != model_sig:
+            _LOG.warning("tuning record %s: model signature mismatch "
+                         "(stored %.16s..., live %.16s...) — rejected",
+                         origin, str(record.get("model_sig")), model_sig)
+            return None
+        if expect_topo is not None and \
+                record.get("topo_digest") != expect_topo:
+            _LOG.warning("tuning record %s: topology digest mismatch "
+                         "(stored %.16s..., live %.16s...) — rejected as "
+                         "stale", origin, str(record.get("topo_digest")),
+                         expect_topo)
+            return None
+        if record.get("space") != space:
+            _LOG.warning("tuning record %s: search space changed — "
+                         "rejected as stale", origin)
+            return None
+        return record
+
+    def _load_exact(self, model_sig: str, space: dict) -> Optional[dict]:
+        if self.dir:
+            path = os.path.join(self.dir, record_filename(
+                model_sig, self.topo_digest))
+            rec = self._read_file(path)
+            if rec is not None:
+                rec = self._validate(rec, model_sig, space,
+                                     self.topo_digest, path)
+                if rec is not None:
+                    return rec
+        if self.kv is not None:
+            try:
+                from ..runner.http_client import read_data_from_kvstore
+                addr, port = self.kv
+                # short deadline: an ABSENT key long-polls to timeout by
+                # design (read_data_from_kvstore), and a cold start —
+                # the common case — must not stall the first step
+                raw = read_data_from_kvstore(
+                    addr, port, KV_SCOPE,
+                    kv_key(model_sig, self.topo_digest),
+                    timeout=min(self.kv_timeout, 2.0))
+                rec = json.loads(raw.decode())
+            except Exception:
+                return None      # absent key / unreachable KV: a miss
+            return self._validate(rec, model_sig, space, self.topo_digest,
+                                  "kv")
+        return None
+
+    def _load_nearest(self, model_sig: str,
+                      space: dict) -> Optional[dict]:
+        """Same model on a different fabric shape (elastic N→M): the
+        candidate whose stored world is nearest in log2(size) distance —
+        ties broken toward matching local_size then larger worlds —
+        seeds the re-tune. File tier only: the KV is not enumerable by
+        design."""
+        if not self.dir or not os.path.isdir(self.dir):
+            return None
+        prefix = f"{_PREFIX}{model_sig[:16]}_"
+        candidates: List[Tuple[float, int, dict]] = []
+        for fname in sorted(os.listdir(self.dir)):
+            if not fname.startswith(prefix) or not fname.endswith(".json"):
+                continue
+            rec = self._read_file(os.path.join(self.dir, fname))
+            if rec is None:
+                continue
+            rec = self._validate(rec, model_sig, space, None, fname)
+            if rec is None or rec.get("topo_digest") == self.topo_digest:
+                # exact-key records were already tried (and rejected or
+                # missed) above; never downgrade one to "nearest"
+                continue
+            topo = rec.get("topology") or {}
+            size = int(topo.get("size", 0))
+            if size <= 0 or topo.get("platform") != \
+                    self.topology.platform:
+                continue
+            # integrity: the stored digest must be the digest OF the
+            # stored topology — a record whose two halves disagree is
+            # corrupt (or tampered) and is rejected, not used as a prior
+            if rec.get("topo_digest") != _topo_digest_of(topo):
+                _LOG.warning("tuning record %s: stored topo_digest does "
+                             "not match its topology payload — rejected "
+                             "as corrupt", fname)
+                continue
+            dist = abs(math.log2(size) -
+                       math.log2(max(self.topology.size, 1)))
+            local_match = 0 if topo.get("local_size") == \
+                self.topology.local_size else 1
+            candidates.append(((dist, local_match, -size), size, rec))
+        if not candidates:
+            return None
+        candidates.sort(key=lambda c: c[0])
+        _, size, rec = candidates[0]
+        _LOG.info("nearest tuning record: stored world %d for live world "
+                  "%d", size, self.topology.size)
+        return rec
+
+    @staticmethod
+    def _read_file(path: str) -> Optional[dict]:
+        try:
+            with open(path, "rb") as f:
+                return json.loads(f.read().decode())
+        except (OSError, ValueError):
+            return None

@@ -160,6 +160,25 @@ HOROVOD_TPU_LOCAL_SIZE = "HOROVOD_TPU_LOCAL_SIZE"
 # auto mode lowers a reduction bucket to the tree form when its payload is
 # at most this many bytes (latency-bound regime; ring bandwidth wins above)
 HOROVOD_TPU_TREE_THRESHOLD_BYTES = "HOROVOD_TPU_TREE_THRESHOLD_BYTES"
+# measured performance model (ISSUE 14, autotune/calibration.py): =1 runs
+# the init-time rank-collective link probe — 3-4 message bands per
+# algorithm class fitted to an α–β cost model — and overlays the measured
+# ICI/DCN bandwidths on the nominal Topology tables (MeasuredTopology);
+# the ring/tree and flat/hierarchical crossover thresholds are then
+# derived from the fit instead of the fixed tree-threshold constant (an
+# explicit HOROVOD_TPU_TREE_THRESHOLD_BYTES still wins). Off by default;
+# size<=1 worlds and probe failures fall back to nominal with a WARNING.
+HOROVOD_TPU_CALIBRATE = "HOROVOD_TPU_CALIBRATE"
+# persistent fleet autotune (ISSUE 14, autotune/persistence.py): PERSIST
+# enables saving/loading converged tuning records keyed by (model
+# signature = bucket-layout digest, topology digest); DIR overrides the
+# record directory (default <HOROVOD_TPU_CHECKPOINT_DIR>/autotune). A
+# restarted job with a matching key warm-starts the tuner at the stored
+# winner (<=1 confirmation cycle); an elastically-resized world re-tunes
+# from the nearest-key prior. Records also publish to the replicated KV
+# ("autotune" scope) when endpoints are wired.
+HOROVOD_TPU_TUNE_PERSIST = "HOROVOD_TPU_TUNE_PERSIST"
+HOROVOD_TPU_TUNE_PERSIST_DIR = "HOROVOD_TPU_TUNE_PERSIST_DIR"
 # link-aware gradient compression (ISSUE 13, ops/compression.py +
 # ops/collectives.py codec reducers): the wire codec applied to reduction
 # payloads — "none" (default), "bf16" (cast, 2 bytes/elem), or the
@@ -347,7 +366,15 @@ class Config:
     zero1_prefetch: bool = True
     collective_algo: str = "auto"
     tree_threshold_bytes: int = DEFAULT_TREE_THRESHOLD_BYTES
+    # flat/hierarchical crossover in bytes — 0 (always hierarchical when
+    # expressible) unless the init-time calibration derived a measured
+    # crossover (ISSUE 14); deliberately not an env knob: it exists only
+    # as a fitted quantity, the tree threshold is the user-facing dial
+    hier_threshold_bytes: int = 0
     compression: str = "none"
+    calibrate: bool = False
+    tune_persist: bool = True
+    tune_persist_dir: Optional[str] = None
     # NOTE: the HOROVOD_TPU_METRICS on/off switch is read by
     # metrics.metrics_enabled() (the registry outlives any Config); only
     # the emitter knobs live here
@@ -362,10 +389,40 @@ class Config:
     checkpoint_redundancy: int = 1
     checkpoint_keep: int = 2
     checkpoint_kv_chunk_bytes: int = 4 * 1024 * 1024
+    # knob provenance (ISSUE 14 bench satellite): tuning-relevant field
+    # -> "env-forced" | "default" at parse time; the calibration overlay
+    # and the autotuner overwrite entries with "calibrated" / "tuned" as
+    # they take ownership, so bench results are self-describing about
+    # where every knob value came from
+    provenance: dict = field(default_factory=dict)
     extra: dict = field(default_factory=dict)
+
+    # the tuned/calibrated knob surface whose provenance the bench reports
+    _PROVENANCE_VARS = {
+        "fusion_threshold_bytes": HOROVOD_FUSION_THRESHOLD,
+        "cycle_time_ms": HOROVOD_CYCLE_TIME,
+        "tree_threshold_bytes": HOROVOD_TPU_TREE_THRESHOLD_BYTES,
+        "collective_algo": HOROVOD_TPU_COLLECTIVE_ALGO,
+        "overlap_pipeline": HOROVOD_TPU_OVERLAP_PIPELINE,
+        "compression": HOROVOD_TPU_COMPRESSION,
+        "single_launch": HOROVOD_TPU_SINGLE_LAUNCH,
+        "step_replay": HOROVOD_TPU_STEP_REPLAY,
+        "shard_optimizer": HOROVOD_TPU_SHARD_OPTIMIZER,
+        "hierarchical_allreduce": HOROVOD_HIERARCHICAL_ALLREDUCE,
+        "hierarchical_allgather": HOROVOD_HIERARCHICAL_ALLGATHER,
+    }
 
     @classmethod
     def from_env(cls) -> "Config":
+        cfg = cls._parse_env()
+        cfg.provenance = {
+            f: ("env-forced" if (os.environ.get(v) or "").strip()
+                else "default")
+            for f, v in cls._PROVENANCE_VARS.items()}
+        return cfg
+
+    @classmethod
+    def _parse_env(cls) -> "Config":
         return cls(
             fusion_threshold_bytes=_get_int(
                 HOROVOD_FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD_BYTES),
@@ -411,6 +468,10 @@ class Config:
                 DEFAULT_TREE_THRESHOLD_BYTES),
             compression=_get_choice(
                 HOROVOD_TPU_COMPRESSION, "none", COMPRESSION_MODES),
+            calibrate=_get_bool(HOROVOD_TPU_CALIBRATE, False),
+            tune_persist=_get_bool(HOROVOD_TPU_TUNE_PERSIST, True),
+            tune_persist_dir=os.environ.get(HOROVOD_TPU_TUNE_PERSIST_DIR)
+            or None,
             metrics_file=os.environ.get(HOROVOD_TPU_METRICS_FILE) or None,
             metrics_interval=_get_float(HOROVOD_TPU_METRICS_INTERVAL, 10.0),
             trace_enabled=_get_bool(HOROVOD_TPU_TRACE, True),

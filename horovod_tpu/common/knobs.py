@@ -149,8 +149,38 @@ KNOB_SPECS: Dict[str, dict] = {
     # -- autotune -----------------------------------------------------------
     "HOROVOD_AUTOTUNE": {
         "type": "bool", "default": "0",
-        "help": "Enable the Bayesian autotuner over fusion threshold, "
-                "cycle time, and the categorical knobs."},
+        "help": "Enable the Bayesian autotuner over the joint knob space: "
+                "fusion threshold, cycle time, tree threshold, and the "
+                "categorical knobs (collective_algo, overlap mode, "
+                "compression codec, hierarchy, replay, sharding)."},
+    "HOROVOD_TPU_CALIBRATE": {
+        "type": "bool", "default": "0",
+        "help": "Run the init-time rank-collective link probe (ISSUE 14): "
+                "3-4 message bands per algorithm class fitted to an "
+                "alpha-beta cost model, measured ICI/DCN bandwidths "
+                "overlaid on the nominal Topology tables, and the "
+                "ring/tree and flat/hierarchical crossover thresholds "
+                "derived from the fit (an explicit "
+                "HOROVOD_TPU_TREE_THRESHOLD_BYTES still wins). Probe "
+                "results are exchanged through the agreement path so "
+                "every rank selects identically; size<=1 worlds and "
+                "probe failures fall back to the nominal tables."},
+    "HOROVOD_TPU_TUNE_PERSIST": {
+        "type": "bool", "default": "1",
+        "help": "Persist converged autotune settings keyed by (model "
+                "signature = bucket-layout digest, topology digest) into "
+                "the tuning-record directory and the replicated KV, and "
+                "warm-start a restarted job with a matching key at the "
+                "stored winner (<=1 confirmation cycle); an elastically "
+                "resized world re-tunes from the nearest-key prior. "
+                "Effective only when a record directory resolves (this "
+                "knob's DIR, or <checkpoint dir>/autotune) or KV "
+                "endpoints are wired."},
+    "HOROVOD_TPU_TUNE_PERSIST_DIR": {
+        "type": "str", "default": "",
+        "help": "Directory for persisted tuning records (default: "
+                "<HOROVOD_TPU_CHECKPOINT_DIR>/autotune when the "
+                "checkpoint tier is enabled)."},
     "HOROVOD_AUTOTUNE_LOG": {
         "type": "str", "default": "",
         "help": "CSV file receiving one line per autotune sample."},

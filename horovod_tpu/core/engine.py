@@ -480,6 +480,22 @@ class Engine:
         # selection.
         if backend.size() > 1:
             self._hierarchical_ok()
+        # Measured performance model (ISSUE 14): with HOROVOD_TPU_CALIBRATE
+        # the init-time rank-collective probe overlays measured link rates
+        # on the nominal topology tables and derives the selection
+        # crossovers from the fitted α–β model. Runs HERE — after the
+        # homogeneity agreement, before any training collective — so the
+        # probe's collectives are in lockstep and every later selection
+        # reads calibrated thresholds. Nominal tables are the fallback on
+        # size<=1 worlds, disabled probing, or probe failure.
+        # The frozen-bucket-layout digest that keys persisted tuning
+        # records (autotune/persistence.py); resolved lazily at the first
+        # grouped call, when the engine first sees the gradient set.
+        self._model_sig: Optional[str] = None
+        if config.calibrate and backend.size() > 1:
+            self._apply_calibration()
+        elif self._m_enabled:
+            _reg.gauge("hvd_tpu_topology_calibrated").set(0.0)
         # Cycle loop: the analog of RunLoopOnce (operations.cc:566-616) — wakes
         # every cycle_time_ms to retire completed handles so fire-and-forget
         # async ops clear the outstanding table without user poll/synchronize.
@@ -544,6 +560,60 @@ class Engine:
         self.dispatch_count += 1
         self._m_dispatches.inc()
 
+    # -- measured performance model (ISSUE 14) -----------------------------
+
+    def _apply_calibration(self):
+        """Run the init-time link probe and install the measured overlay:
+        topology becomes a MeasuredTopology, and — unless the user pinned
+        HOROVOD_TPU_TREE_THRESHOLD_BYTES — the ring/tree and
+        flat/hierarchical crossovers become the fitted model's derived
+        values. The probe result was cross-rank agreed inside
+        calibrate_engine, so the installed thresholds are identical
+        everywhere (the selection-determinism invariant)."""
+        from ..autotune.calibration import calibrate_engine, \
+            derived_thresholds
+        measured = calibrate_engine(self)
+        _reg = metrics_registry()
+        if measured is None:
+            _reg.gauge("hvd_tpu_topology_calibrated").set(0.0)
+            return
+        self.topology = measured
+        tree_thr, hier_thr = derived_thresholds(measured)
+        prov = self.config.provenance
+        if prov.get("tree_threshold_bytes") == "env-forced":
+            logging.getLogger("horovod_tpu").info(
+                "calibration derived tree threshold %d B but "
+                "HOROVOD_TPU_TREE_THRESHOLD_BYTES is set; the explicit "
+                "knob wins", tree_thr)
+        else:
+            self.config.tree_threshold_bytes = tree_thr
+            prov["tree_threshold_bytes"] = "calibrated"
+        self.config.hier_threshold_bytes = hier_thr
+        prov["hier_threshold_bytes"] = "calibrated"
+        _reg.gauge("hvd_tpu_topology_calibrated").set(1.0)
+        link_g = _reg.gauge("hvd_tpu_link_gbps")
+        link_g.set(measured.ici_gbps, link="ici", source="measured")
+        link_g.set(measured.dcn_gbps, link="dcn", source="measured")
+        link_g.set(measured.nominal_ici_gbps, link="ici", source="nominal")
+        link_g.set(measured.nominal_dcn_gbps, link="dcn", source="nominal")
+
+    def _note_model_sig(self, tensors) -> None:
+        """Freeze the model signature at the FIRST grouped call: the
+        digest of the gradient set's (shape, dtype) layout — the
+        persistence key half that identifies "the same model" across
+        restarts and resizes. Shapes only, never names (the optimizer's
+        per-step names carry digits) and never values."""
+        if self._model_sig is not None or not tensors:
+            return
+        import hashlib
+        text = ";".join(f"{tuple(t.shape)}:{t.dtype}" for t in tensors)
+        self._model_sig = hashlib.sha256(text.encode()).hexdigest()
+
+    def model_signature(self) -> Optional[str]:
+        """The frozen bucket-layout digest (None before the first grouped
+        call)."""
+        return self._model_sig
+
     # -- topology-aware collective algorithm selection (ISSUE 10) ----------
 
     def _choose_algo(self, kind: str, nbytes: int) -> str:
@@ -580,7 +650,8 @@ class Engine:
         else:
             algo = C.choose_algorithm(
                 kind, nbytes, topo,
-                tree_threshold_bytes=self.config.tree_threshold_bytes)
+                tree_threshold_bytes=self.config.tree_threshold_bytes,
+                hier_threshold_bytes=self.config.hier_threshold_bytes)
         if algo == C.ALGO_HIERARCHICAL and not hier_ok:
             return C.ALGO_FLAT
         return algo
@@ -607,6 +678,7 @@ class Engine:
         to re-arm on any move."""
         cfg = self.config
         return (cfg.collective_algo, cfg.tree_threshold_bytes,
+                cfg.hier_threshold_bytes,
                 cfg.hierarchical_allreduce, cfg.hierarchical_allgather,
                 cfg.compression)
 
@@ -1006,6 +1078,15 @@ class Engine:
             if tok == self._pm_marked_token:
                 return
             self._pm_marked_token = tok
+        # persistent-autotune warm start (ISSUE 14): one-shot, at the
+        # first step boundary — the earliest point the model signature
+        # exists. Every rank reaches this call in the same program order
+        # and the record rides the parameter-sync broadcast inside, so
+        # the adopted knob vector is identical everywhere. getattr: the
+        # pm face is duck-typed (test doubles implement a subset).
+        warm = getattr(pm, "maybe_warm_start", None)
+        if warm is not None:
+            warm(self._model_sig)
         if pm.active:
             # program-ordered autotune step boundary: score the previous
             # step, possibly retune knobs (collective sync inside is safe
@@ -1022,30 +1103,32 @@ class Engine:
                      "single_launch", "step_replay", "shard_optimizer"):
             if pm.tunes(knob):
                 setattr(self.config, knob, pm.categorical_value(knob))
-        # overlap_pipeline is a string-mode knob: the categorical toggles
-        # between "off" and the env-resolved base mode (auto/interleave/
-        # staged), so the tuner explores serial-vs-pipelined without
-        # inventing modes the user did not ask for
+        # string-mode knobs (ISSUE 14 joint space): the tuner explores
+        # the declared choice set directly — the value IS the config
+        # string. Legacy boolean declarations keep the PR 6/10/13
+        # base-vs-off encoding so older wirings stay valid.
         if pm.tunes("overlap_pipeline"):
+            v = pm.categorical_value("overlap_pipeline")
             self.config.overlap_pipeline = (
-                self._overlap_base
-                if pm.categorical_value("overlap_pipeline") else "off")
-        # collective_algo is the same boolean-over-string pattern: the
-        # categorical explores topology-aware selection (the env-resolved
-        # base — auto or a forced algorithm) vs the flat ring everywhere
+                v if isinstance(v, str)
+                else (self._overlap_base if v else "off"))
         if pm.tunes("collective_algo"):
+            v = pm.categorical_value("collective_algo")
             self.config.collective_algo = (
-                self._algo_base
-                if pm.categorical_value("collective_algo") else "flat")
-        # compression is the same boolean-over-string pattern: the
-        # categorical explores the env-resolved codec vs no compression
-        # (only offered when the user enabled a codec — autotune never
-        # silently turns lossy compression ON, state.py)
+                v if isinstance(v, str)
+                else (self._algo_base if v else "flat"))
+        # compression is only offered when the user enabled a codec —
+        # autotune never silently turns lossy compression ON (state.py)
         if pm.tunes("compression"):
+            v = pm.categorical_value("compression")
             self.config.compression = (
-                self._codec_base
-                if pm.categorical_value("compression")
-                else comp.CODEC_NONE)
+                v if isinstance(v, str)
+                else (self._codec_base if v else comp.CODEC_NONE))
+        # the tree threshold joined the numeric dims (ISSUE 14): the
+        # calibrated derivation seeds it, the GP refines it; replay
+        # re-arms through _algo_sig on every move
+        if getattr(pm, "tunes_tree_threshold", False):
+            self.config.tree_threshold_bytes = pm.tree_threshold_bytes
 
     def _dispatch(self, names, fn, *args):
         """Dispatch with failure translation + a timeline ACTIVITY span per
@@ -1558,6 +1641,7 @@ class Engine:
                             | (comp.CODECS.index(call_codec) << 4))
                          for t in tensors],
                         skip=sub)
+        self._note_model_sig(tensors)
         self._pm_step(sum(t.nbytes for t in tensors))
         names = [self._register(None if name is None else f"{name}.{i}",
                                 "grouped_allreduce", t.nbytes,
@@ -1801,6 +1885,7 @@ class Engine:
         self._join_sync("sharded_step",
                         [_join_meta_row(t, int(op)) for t in tensors],
                         skip=sub)
+        self._note_model_sig(tensors)
         self._pm_step(sum(t.nbytes for t in tensors))
         def _sharded_link_bytes(i, t):
             # a sharded tensor moves once over the flat rs ring (encoded

@@ -191,26 +191,44 @@ class GlobalState:
             # with sharded=None AFTER the flip — live optimizer state
             # shapes are frozen at their init (optimizer._is_sharded).
             categorical += ["shard_optimizer"]
-            # bucket-pipelined comm/compute overlap (ISSUE 6): serial vs
-            # pipelined collective schedule inside the fused step. The
-            # categorical toggles "off" vs the env-resolved base mode
-            # (engine._pm_step maps the boolean onto the string knob);
-            # whether overlap pays is a per-runtime fact — dispatch
-            # overhead vs wire time — exactly the step_replay trade.
-            categorical += ["overlap_pipeline"]
+            # STRING-VALUED categoricals (ISSUE 14 joint space; the PR 10
+            # boolean-over-string encoding retired): the tuner explores
+            # the declared choice set directly, one [0,1] GP dim evenly
+            # partitioned over it. Choice tuples are built from the same
+            # collectively-agreed facts as the boolean offers, so every
+            # rank constructs the identical search space — and the tuple
+            # is ordered deterministically, so a persisted record's
+            # encoding stays valid across restarts on the same topology.
+            size = self.backend.size()
+            hier_ok = size > 1 and self.engine._hierarchical_ok()
+            # bucket-pipelined comm/compute overlap (ISSUE 6): the three
+            # explicit schedules plus "auto" (the per-bytes resolver) so
+            # the env default stays expressible as the starting point.
+            categorical += [("overlap_pipeline",
+                             ("off", "interleave", "staged", "auto"))]
             # topology-aware collective algorithm selection (ISSUE 10):
-            # env-resolved base (auto / forced) vs flat-ring everywhere.
-            # Always expressible — selection demotes (never crashes) on
-            # topologies an algorithm cannot serve, and the choice is
-            # deterministic in (bytes, topology, knobs) so every rank
-            # flips identically at sample boundaries.
-            categorical += ["collective_algo"]
-            # link-aware gradient compression (ISSUE 13): env-resolved
-            # codec vs none — offered ONLY when the user enabled a codec
-            # (autotune must never silently turn lossy compression on;
-            # the codec-vs-wire-time trade is what it explores)
+            # auto (per-bucket selection) plus every forcing this world
+            # can express — selection still demotes (never crashes), so
+            # the offer errs permissive; tree needs a power-of-2 world
+            # of >= 4, hierarchical the agreed factorization.
+            algo_choices = ["auto", "flat"]
+            if size >= 4 and (size & (size - 1)) == 0:
+                algo_choices.append("tree")
+            if hier_ok:
+                algo_choices.append("hierarchical")
+            categorical += [("collective_algo", tuple(algo_choices))]
+            # link-aware gradient compression (ISSUE 13): offered ONLY
+            # when the user enabled a codec (autotune must never silently
+            # turn lossy compression on); the choice set is none vs the
+            # user's codec — the codec-vs-wire-time trade it explores.
             if cfg.compression != "none":
-                categorical += ["compression"]
+                categorical += [("compression",
+                                 ("none", cfg.compression))]
+            # calibrated-model seeding (ISSUE 14): when the init probe
+            # measured the fabric, the first explored candidates are the
+            # measured model's predictions, not random points — built
+            # after the manager exists (encode needs its space).
+            topo = self.engine.topology
             self.parameter_manager = ParameterManager(
                 warmup_samples=cfg.autotune_warmup_samples,
                 steps_per_sample=cfg.autotune_steps_per_sample,
@@ -232,10 +250,46 @@ class GlobalState:
                     "single_launch": cfg.single_launch,
                     "step_replay": cfg.step_replay,
                     "shard_optimizer": cfg.shard_optimizer,
-                    "overlap_pipeline": cfg.overlap_pipeline != "off",
-                    "collective_algo": cfg.collective_algo != "flat",
-                    "compression": cfg.compression != "none",
-                })
+                    "overlap_pipeline": cfg.overlap_pipeline,
+                    "collective_algo": cfg.collective_algo,
+                    "compression": cfg.compression,
+                },
+                # the tree threshold joins the numeric dims, initialized
+                # at the calibrated derivation when the probe ran (the
+                # engine already installed it in cfg) — unless the user
+                # pinned it via env, which the tuner must respect just
+                # like the calibration overlay does
+                tune_tree_threshold=(
+                    cfg.provenance.get("tree_threshold_bytes")
+                    != "env-forced"),
+                initial_tree_threshold=cfg.tree_threshold_bytes)
+            if topo.calibrated:
+                self.parameter_manager._seed_suggestions.extend(
+                    _calibration_seeds(self.parameter_manager, topo, cfg))
+            # persistent fleet autotune (ISSUE 14): records keyed by
+            # (model signature, topology digest) in the tuning dir +
+            # replicated KV; the manager consults the store at its first
+            # step boundary (rank 0 lookup, broadcast result) and writes
+            # back at convergence.
+            tune_dir = cfg.tune_persist_dir or (
+                os.path.join(cfg.checkpoint_dir, "autotune")
+                if cfg.checkpoint_dir else None)
+            if cfg.tune_persist and (tune_dir or kv is not None):
+                from ..autotune.persistence import TuningStore
+                store = TuningStore(tune_dir, topo,
+                                    rank=self.backend.rank(), kv=kv)
+                self.parameter_manager.attach_persistence(store)
+            # provenance: every knob the manager actually drives —
+            # numerics plus the full categorical surface — is now owned
+            # by the tuner for the rest of the engine's life (bench
+            # self-description)
+            tuned = ["fusion_threshold_bytes", "cycle_time_ms"]
+            if self.parameter_manager.tunes_tree_threshold:
+                tuned.append("tree_threshold_bytes")
+            tuned += [c[0] if isinstance(c, tuple) else c
+                      for c in categorical]
+            for knob in tuned:
+                cfg.provenance[knob] = "tuned"
             self.engine.parameter_manager = self.parameter_manager
 
         engine = self.engine
@@ -320,6 +374,39 @@ class GlobalState:
     @property
     def initialized(self) -> bool:
         return self.backend is not None and self.backend.initialized
+
+
+def _calibration_seeds(pm, topo, cfg) -> list:
+    """Knob vectors the measured link model predicts to win, tried by the
+    tuner BEFORE any random exploration (ISSUE 14: seeded from
+    calibration, not cold priors). Deterministic in (measured model,
+    config) — every rank builds the same list, and the rank-0 parameter
+    broadcast keeps sampling in lockstep regardless."""
+    from ..ops import collectives as C
+    seeds = []
+    # the fitted model's own derivation: calibrated thresholds with
+    # per-bucket auto selection — what the measurement says is optimal
+    seeds.append(pm.encode(
+        tree_threshold_bytes=cfg.tree_threshold_bytes,
+        categorical_values={"collective_algo": "auto"}))
+    # the measured per-class fits ranked at a typical large bucket: when
+    # the ladder (or the flat ring) measured strictly faster there, try
+    # forcing it early — one sample settles what the GP would need
+    # several for
+    probe_bytes = min(cfg.fusion_threshold_bytes, 32 * 1024 * 1024)
+    costs = {}
+    for algo in ("flat", "hierarchical"):
+        fit = topo.fitted(algo)
+        if fit is not None:
+            alpha, beta = fit
+            costs[algo] = alpha + probe_bytes / beta
+    if len(costs) == 2:
+        fastest = min(costs, key=costs.get)
+        if fastest != "flat":
+            seeds.append(pm.encode(
+                tree_threshold_bytes=cfg.tree_threshold_bytes,
+                categorical_values={"collective_algo": fastest}))
+    return seeds
 
 
 def _apply_log_level():

@@ -237,11 +237,23 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
     "hvd_tpu_autotune_cycle_time_ms": (
         "gauge", "Current autotuned cycle time"),
     "hvd_tpu_autotune_categorical": (
-        "gauge", "Current value of each tuned categorical knob (0/1), by "
-                 "knob name"),
+        "gauge", "Current value of each tuned categorical knob, by knob "
+                 "name: 0/1 for boolean knobs, the chosen index into the "
+                 "declared choice tuple for string-valued knobs"),
     "hvd_tpu_autotune_active": (
         "gauge", "Whether the autotuner is still sampling (1) or has "
                  "converged (0)"),
+    "hvd_tpu_autotune_warm_starts_total": (
+        "counter", "Warm-start resolutions against the persistent tuning "
+                   "store, by kind (exact = stored winner adopted, "
+                   "nearest = N->M resize prior, miss = no usable "
+                   "record)"),
+    "hvd_tpu_topology_calibrated": (
+        "gauge", "Whether the engine's link table is measured-on-pod "
+                 "(1, ISSUE 14 init-time probe) or nominal (0)"),
+    "hvd_tpu_link_gbps": (
+        "gauge", "Per-fabric link bandwidth the selection layer is using, "
+                 "by link (ici/dcn) and source (nominal/measured)"),
 }
 
 

@@ -117,7 +117,8 @@ def validate_algorithm(kind: str, algo: str, n: int, local_size: int) -> str:
 def choose_algorithm(kind: str, nbytes: int, topology,
                      force: str = "auto",
                      tree_threshold_bytes: int =
-                     DEFAULT_TREE_THRESHOLD_BYTES) -> str:
+                     DEFAULT_TREE_THRESHOLD_BYTES,
+                     hier_threshold_bytes: int = 0) -> str:
     """Pick the lowering for ONE bucket of ``kind`` carrying ``nbytes``
     per rank over ``topology`` (a :class:`~..parallel.mesh.Topology`).
 
@@ -132,7 +133,11 @@ def choose_algorithm(kind: str, nbytes: int, topology,
     - above the threshold, allreduce/allgather take the hierarchical
       ICI/DCN ladder when the topology has an exact non-trivial slice
       decomposition (cross traffic 1/local_size — the reference's
-      NCCL-RS -> MPI-AR -> NCCL-AG ladder, nccl_operations.cc:180-383);
+      NCCL-RS -> MPI-AR -> NCCL-AG ladder, nccl_operations.cc:180-383)
+      AND the payload reaches ``hier_threshold_bytes`` — the calibrated
+      flat/hierarchical crossover (autotune/calibration.py: the ladder's
+      extra launches cost α before its bandwidth win pays). The default
+      0 keeps the nominal always-hierarchical behavior;
     - otherwise the flat ring.
 
     Deterministic in (kind, bytes, topology, knobs) — every rank that
@@ -149,7 +154,8 @@ def choose_algorithm(kind: str, nbytes: int, topology,
     if (kind == "allreduce" and nbytes <= tree_threshold_bytes
             and n >= 4 and _is_pow2(n)):
         return ALGO_TREE
-    if kind in ("allreduce", "allgather") and topology.hierarchical_ok:
+    if (kind in ("allreduce", "allgather") and topology.hierarchical_ok
+            and nbytes >= hier_threshold_bytes):
         return ALGO_HIERARCHICAL
     return ALGO_FLAT
 

@@ -15,11 +15,12 @@ equivalent is a ``jax.sharding.Mesh``:
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import math
 import os
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
@@ -139,6 +140,24 @@ class Topology:
                 "dcn_gbps": self.dcn_gbps,
                 "hierarchical_ok": self.hierarchical_ok}
 
+    def digest(self) -> str:
+        """Stable identity of the fabric SHAPE — the persistence key half
+        that decides whether a stored tuning record applies to this world
+        (autotune/persistence.py). Deliberately excludes the bandwidth
+        numbers: measured link rates vary run to run on the same pod, and
+        a record keyed on them would never match again. Excludes
+        ``source`` too (override vs probe must not fork the key for the
+        same shape)."""
+        text = f"{self.size}|{self.local_size}|{self.num_slices}|" \
+               f"{self.platform}"
+        return hashlib.sha256(text.encode()).hexdigest()
+
+    @property
+    def calibrated(self) -> bool:
+        """Whether the link table is measured-on-pod (MeasuredTopology)
+        rather than the nominal per-generation figures."""
+        return False
+
     # -- mesh integration --------------------------------------------------
 
     def hierarchical_mesh(self,
@@ -154,6 +173,76 @@ class Topology:
         :func:`multislice_mesh`, which uses the hybrid device mesh on real
         multi-slice hardware)."""
         return multislice_mesh(dcn_axes, ici_axes, devices)
+
+
+@dataclass(frozen=True)
+class MeasuredTopology(Topology):
+    """A :class:`Topology` whose link table was CALIBRATED by the engine's
+    init-time probe (autotune/calibration.py) instead of taken from the
+    nominal per-generation constants.
+
+    ``ici_gbps``/``dcn_gbps`` hold the measured figures, so every
+    consumer of the base descriptor (roofline helpers, bench sweeps,
+    selection) sees calibrated numbers transparently; the nominal values
+    stay visible in ``nominal_ici_gbps``/``nominal_dcn_gbps`` so the
+    bench can report the nominal-vs-measured delta. ``launch_latency_us``
+    is the fitted per-launch α of the α–β cost model, and ``link_model``
+    maps each probed algorithm class to its fitted ``(alpha_s,
+    beta_bytes_per_s)`` pair — the inputs the derived crossover
+    thresholds (autotune/calibration.py) come from.
+
+    ``digest()`` is inherited unchanged: calibration never forks the
+    persistence key — two runs on the same fabric shape share tuning
+    records even when their probes measured slightly different rates.
+    """
+
+    nominal_ici_gbps: float = 0.0
+    nominal_dcn_gbps: float = 0.0
+    launch_latency_us: float = 0.0
+    # (("flat", alpha_s, beta_bytes_per_s), ("hierarchical", ...), ...)
+    link_model: Tuple[Tuple[str, float, float], ...] = ()
+
+    @property
+    def calibrated(self) -> bool:
+        return True
+
+    def fitted(self, algo: str) -> Optional[Tuple[float, float]]:
+        """The fitted ``(alpha_s, beta_bytes_per_s)`` pair for one probed
+        algorithm class, or None when that class was not probed (e.g.
+        hierarchical on a flat world)."""
+        for name, alpha, beta in self.link_model:
+            if name == algo:
+                return (alpha, beta)
+        return None
+
+    def describe(self) -> dict:
+        d = super().describe()
+        d.update({"calibrated": True,
+                  "nominal_ici_gbps": self.nominal_ici_gbps,
+                  "nominal_dcn_gbps": self.nominal_dcn_gbps,
+                  "launch_latency_us": round(self.launch_latency_us, 2),
+                  "link_model": {name: {"alpha_us": round(a * 1e6, 2),
+                                        "beta_gbps": round(b / 1e9, 3)}
+                                 for name, a, b in self.link_model}})
+        return d
+
+
+def measured_topology(base: Topology, ici_gbps: float, dcn_gbps: float,
+                      launch_latency_us: float,
+                      link_model: Dict[str, Tuple[float, float]]
+                      ) -> MeasuredTopology:
+    """Overlay measured link rates on a nominal descriptor. The base's
+    shape fields carry over unchanged (same ``digest()``); only the
+    bandwidth table and the fitted α–β model are new."""
+    return MeasuredTopology(
+        size=base.size, local_size=base.local_size,
+        platform=base.platform, source=base.source,
+        ici_gbps=float(ici_gbps), dcn_gbps=float(dcn_gbps),
+        nominal_ici_gbps=base.ici_gbps, nominal_dcn_gbps=base.dcn_gbps,
+        launch_latency_us=float(launch_latency_us),
+        link_model=tuple(sorted(
+            (name, float(a), float(b))
+            for name, (a, b) in link_model.items())))
 
 
 def _slice_local_size(devices: Sequence[jax.Device]) -> Tuple[int, str]:

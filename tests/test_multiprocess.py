@@ -1122,3 +1122,56 @@ def test_np4_compression_dcn_drop_hierarchical():
         assert r["ici_none"] == r["ici_i8"] > 0, r       # ICI unchanged
         assert r["err_none"] < 1e-3, r
         assert r["err_i8"] < 0.5, r   # bounded quantization error
+
+
+def _worker_calibrated_selection():
+    """ISSUE 14 acceptance: np=2 with probing ON — the init-time link
+    probe runs rank-collectively, the fitted model rides the agreement
+    exchange, and every rank derives the SAME calibrated thresholds and
+    the SAME per-bucket algorithm choice (selection determinism, the
+    divcheck invariant, now over measured inputs)."""
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+    rank = hvd.rank()
+    eng = hvd._engine()
+    topo = eng.topology
+    # the per-bucket selection the engine would make across the band a
+    # real step's fusion buckets span
+    sizes = [4 * 1024, 64 * 1024, 1024 ** 2, 8 * 1024 ** 2]
+    choices = [eng._choose_algo("allreduce", s) for s in sizes]
+    # calibrated selection must still be EXACT end to end
+    x = np.arange(8.0, dtype=np.float32) * (rank + 1)
+    out = np.asarray(hvd.allreduce(x, name="cal.ar", op=hvd.Sum))
+    np.testing.assert_allclose(out, np.arange(8.0) * 3.0, rtol=1e-6)
+    g0, g1 = hvd.grouped_allreduce([x, x + 1.0], name="cal.g",
+                                   op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(g0), np.arange(8.0) * 3.0,
+                               rtol=1e-6)
+    return {"rank": rank,
+            "calibrated": topo.calibrated,
+            "describe": topo.describe(),
+            "choices": choices,
+            "tree_thr": eng.config.tree_threshold_bytes,
+            "hier_thr": eng.config.hier_threshold_bytes,
+            "model_sig": eng.model_signature()}
+
+
+@pytest.mark.integration
+def test_np2_calibrated_selection_deterministic():
+    from horovod_tpu.runner import run
+    env = dict(_mp_env())
+    env["HOROVOD_TPU_CALIBRATE"] = "1"
+    r0, r1 = run(_worker_calibrated_selection, np=2, env=env)
+    # the probe ran and the measured overlay is installed on both ranks
+    assert r0["calibrated"] and r1["calibrated"]
+    # every rank fitted the IDENTICAL model (the agreement exchange) and
+    # therefore derives identical thresholds and identical per-bucket
+    # algorithm choices — bit-equality, not approximate
+    assert r0["describe"] == r1["describe"]
+    assert r0["choices"] == r1["choices"]
+    assert r0["tree_thr"] == r1["tree_thr"]
+    assert r0["hier_thr"] == r1["hier_thr"]
+    # the frozen bucket-layout digest (the persistence key) agrees too
+    assert r0["model_sig"] == r1["model_sig"] is not None

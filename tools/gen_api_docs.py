@@ -108,6 +108,12 @@ SECTIONS = [
         "render_cluster_trace", "clock_offset", "load_trace_events",
         "load_trace_file", "make_corr", "parse_corr"]),
     ("Autotuning", "horovod_tpu.autotune.parameter_manager", []),
+    ("", "horovod_tpu.autotune.calibration", [
+        "fit_alpha_beta", "derived_tree_threshold_bytes",
+        "derived_hier_threshold_bytes", "probe_link_times",
+        "agree_times", "fit_measured_topology", "derived_thresholds",
+        "calibrate_engine"]),
+    ("", "horovod_tpu.autotune.persistence", []),
     ("Static analysis", "horovod_tpu.analysis", []),
     ("", "horovod_tpu.analysis.lockcheck", []),
     ("", "horovod_tpu.analysis.divcheck", []),

@@ -186,6 +186,112 @@ def wire_vs_gap(events: List[dict]) -> Dict[int, dict]:
     return out
 
 
+def gap_attribution(events: List[dict],
+                    skews: Optional[Dict[str, dict]] = None
+                    ) -> Dict[int, dict]:
+    """Per-rank attribution of step time into its four sinks (ISSUE 14 /
+    ROADMAP item 5: the post-tune report must prove where the remaining
+    MFU gap lives):
+
+    - **dispatch** — host time spent inside XLA launches (the X spans of
+      ``cat == "dispatch"`` clipped to STEP windows): per-launch
+      overhead, the thing replay/overlap/fusion shrink;
+    - **straggler_wait** — time this rank sat waiting for LATER arrivals
+      at correlated collectives (per corr id: last-arrival ts minus this
+      rank's arrival ts, clipped into the step windows' total): load
+      imbalance, input-pipeline skew;
+    - **wire** — collective in-flight time (B→E spans clipped to STEP
+      windows) beyond what dispatch and straggler-wait already explain:
+      actual byte movement on the critical path, the thing
+      compression/topology-selection shrink;
+    - **compute** — everything else: the model's math plus any host gap.
+      After the tuner has flattened the other three, this is the MFU
+      numerator's home.
+
+    Ranks without STEP spans attribute over their whole trace span (the
+    ``wire_vs_gap`` convention). All figures are totals across the
+    rank's steps, with a ``pct`` breakdown of the step total."""
+    if skews is None:
+        skews = arrival_skew(events)
+    steps: Dict[int, List[Tuple[float, float]]] = {}
+    dispatch: Dict[int, List[Tuple[float, float]]] = {}
+    opens: Dict[Tuple[int, str], float] = {}
+    inflight: Dict[int, List[Tuple[float, float]]] = {}
+    span: Dict[int, Tuple[float, float]] = {}
+
+    def _grow(pid, lo, hi):
+        a, b = span.get(pid, (lo, hi))
+        span[pid] = (min(a, lo), max(b, hi))
+
+    for ev in events:
+        ph = ev.get("ph")
+        pid = int(ev.get("pid", 0))
+        if ph == "X":
+            t0 = float(ev.get("ts", 0.0))
+            dur = float(ev.get("dur", 0.0))
+            _grow(pid, t0, t0 + dur)
+            if ev.get("name") == "STEP":
+                steps.setdefault(pid, []).append((t0, t0 + dur))
+            elif ev.get("cat") == "dispatch" or \
+                    str(ev.get("name", "")).startswith("XLA_"):
+                dispatch.setdefault(pid, []).append((t0, t0 + dur))
+        elif ph in ("B", "E"):
+            t = float(ev.get("ts", 0.0))
+            _grow(pid, t, t)
+            corr = _corr_of(ev)
+            if corr is None:
+                continue
+            if ph == "B":
+                opens[(pid, corr)] = t
+            else:
+                t0 = opens.pop((pid, corr), None)
+                if t0 is not None and t > t0:
+                    inflight.setdefault(pid, []).append((t0, t))
+    # per-rank straggler wait: how long each correlated collective's
+    # last arrival made THIS rank wait past its own arrival
+    waited: Dict[int, float] = {}
+    for ent in skews.values():
+        last_ts = ent["arrivals"][ent["last"]]
+        for pid, ts in ent["arrivals"].items():
+            if last_ts > ts:
+                waited[pid] = waited.get(pid, 0.0) + (last_ts - ts)
+
+    def _clip_total(spans, windows):
+        if not windows:
+            return sum(b - a for a, b in spans)
+        return sum(min(b, wb) - max(a, wa)
+                   for a, b in spans for wa, wb in windows
+                   if min(b, wb) > max(a, wa))
+
+    out: Dict[int, dict] = {}
+    for pid in sorted(set(steps) | set(dispatch) | set(inflight)
+                      | set(span)):
+        st = steps.get(pid, [])
+        if st:
+            total = sum(b - a for a, b in st)
+            n = len(st)
+        else:
+            lo, hi = span.get(pid, (0.0, 0.0))
+            total, n = hi - lo, 1 if span.get(pid) else 0
+        disp = min(_clip_total(dispatch.get(pid, []), st), total)
+        wait = min(waited.get(pid, 0.0), max(total - disp, 0.0))
+        infl = _clip_total(inflight.get(pid, []), st)
+        wire = min(max(infl - disp - wait, 0.0),
+                   max(total - disp - wait, 0.0))
+        compute = max(total - disp - wait - wire, 0.0)
+        row = {"steps": len(st), "total_us": total,
+               "compute_us": compute, "dispatch_us": disp,
+               "wire_us": wire, "straggler_wait_us": wait}
+        row["pct"] = {
+            k[:-3]: (round(100.0 * row[k] / total, 2) if total > 0
+                     else 0.0)
+            for k in ("compute_us", "dispatch_us", "wire_us",
+                      "straggler_wait_us")}
+        row["per_step_total_us"] = total / n if n else 0.0
+        out[pid] = row
+    return out
+
+
 def critical_path(events: List[dict],
                   skews: Dict[str, dict]) -> dict:
     """A coarse critical-path estimate: total dispatch (wire) time plus
@@ -271,6 +377,7 @@ def analyze(events: List[dict]) -> dict:
         "stragglers": ranking,
         "top_straggler": ranking[0]["rank"] if ranking else None,
         "wire_vs_gap": wire_vs_gap(events),
+        "gap_attribution": gap_attribution(events, skews),
         "critical_path": critical_path(events, skews),
         "overlap": overlap_report(events),
     }
@@ -409,6 +516,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"wire={_fmt_us(w['wire_us']):<10} "
                   f"gap={_fmt_us(w['gap_us']):<10} "
                   f"(per-step {_fmt_us(w['per_step_total_us'])})")
+    if rep["gap_attribution"]:
+        print("\ngap attribution (per-step time -> compute / dispatch / "
+              "wire / straggler-wait):")
+        for pid, g in sorted(rep["gap_attribution"].items()):
+            pct = g["pct"]
+            print(f"  rank {pid:<4} steps={g['steps']:<4} "
+                  f"compute={pct['compute']:5.1f}%  "
+                  f"dispatch={pct['dispatch']:5.1f}%  "
+                  f"wire={pct['wire']:5.1f}%  "
+                  f"straggler={pct['straggler_wait']:5.1f}%  "
+                  f"(per-step {_fmt_us(g['per_step_total_us'])})")
     cp = rep["critical_path"]
     print(f"\ncritical-path estimate: {_fmt_us(cp['total_us'])} "
           f"(wire {_fmt_us(cp['wire_us'])} + straggler waits "
