@@ -28,12 +28,23 @@ this package extends it from *names* to *behavior*:
   declared knob must actually be read somewhere), declared defaults must
   be consistent with their types/choices, and choice knobs must be read
   through the registry parser.
+- :mod:`.errflow` — the exception-propagation & resource-lifecycle
+  analyzer (ISSUE 15): a cross-file call-graph pass over the recovery
+  invariant — no broad ``except`` may swallow a recovery-class error on
+  the elastic/dispatch/watchdog path, raw transport calls carry
+  deadlines or ride ``retrying()``, resources are released on the
+  exception edge (threads joined on some shutdown path), declared error
+  seams stay observable, and ``FAULT_SPECS`` never drifts from the
+  ``failpoint()`` call sites (both directions).
+- :mod:`.faultcheck` / :mod:`.metriccheck` — the failpoint- and
+  metric-namespace lints (folded in from ``tools/check_*_names.py`` by
+  ISSUE 15; the ``tools/`` scripts remain as thin CLI shims).
 
 All are pure-stdlib AST passes (no runtime import of the modules they
-scan). ``tools/check.py`` is the unified driver that runs them next to
-the metric-name, fault-name, trace-schema, and checkpoint-manifest
-lints as one command with one machine-readable report; see
-``docs/static_analysis.md``.
+scan; the name lints import only the registry tables they validate).
+``tools/check.py`` is the unified driver that runs them next to the
+trace-schema and checkpoint-manifest lints as one command with one
+machine-readable report; see ``docs/static_analysis.md``.
 """
 
 import ast

@@ -458,7 +458,7 @@ class ParameterManager:
             if self._on_converged is not None:
                 try:
                     self._on_converged(self._convergence_record(best_y))
-                except Exception as e:  # persistence is best-effort
+                except Exception as e:  # errflow: ignore[tuning-record persistence is best-effort (WARNING logged); training must never depend on the tune store]
                     _LOG.warning("tuning-record save failed: %s", e)
         else:
             self._current = self._next_point()

@@ -221,6 +221,7 @@ class Handle:
             try:
                 if self._result is None and self._error is None:
                     self._result = self._extract(self._garrs)
+            # errflow: ignore[the error is attached to the handle — every later synchronize()/result() re-raises it (handle-manager semantics)]
             except Exception as e:
                 # A permanently-failed extract (e.g. the deferred size-cache
                 # check) retires WITH the error attached: the handle leaves
@@ -499,13 +500,20 @@ class Engine:
         # Cycle loop: the analog of RunLoopOnce (operations.cc:566-616) — wakes
         # every cycle_time_ms to retire completed handles so fire-and-forget
         # async ops clear the outstanding table without user poll/synchronize.
-        self._running = True
+        # Event-paced (not time.sleep + flag): stop() wakes the loop and
+        # JOINS it, so an elastic teardown never leaves a zombie cycle
+        # thread retiring handles while the next world's engine spins up
+        # (errflow leak-on-raise audit; the StallInspector.stop pattern).
+        self._cycle_stop = threading.Event()
         self._cycle_thread = threading.Thread(target=self._cycle_loop,
                                               name="hvd-cycle", daemon=True)
         self._cycle_thread.start()
 
     def stop(self):
-        self._running = False
+        self._cycle_stop.set()
+        if self._cycle_thread.is_alive() and \
+                threading.current_thread() is not self._cycle_thread:
+            self._cycle_thread.join(timeout=10)
 
     def poison(self, err: Exception):
         """Mark the engine dead (collective-watchdog escalation): every
@@ -519,11 +527,11 @@ class Engine:
             raise self._poison
 
     def _cycle_loop(self):
-        # lockcheck: ignore[single-writer shutdown flag: stop() only transitions it True->False, a stale read costs one extra tick]
-        while self._running:
-            # cycle time is re-read every iteration so the autotuner can
-            # retune it live (parameter_manager.h:178-220)
-            time.sleep(max(self.config.cycle_time_ms, 1.0) / 1000.0)
+        # cycle time is re-read every wait so the autotuner can retune it
+        # live (parameter_manager.h:178-220); the Event wait (vs sleep)
+        # lets stop() wake and join the loop immediately
+        while not self._cycle_stop.wait(
+                max(self.config.cycle_time_ms, 1.0) / 1000.0):
             with self._lock:
                 pending = list(self._outstanding.values())
             for h in pending:

@@ -16,6 +16,7 @@ import glob
 import os
 import queue
 import threading
+import time
 from typing import Iterator, List, Sequence, Tuple
 
 import numpy as np
@@ -192,9 +193,17 @@ class ShardBatchIterator:
                 yield np.concatenate(carry_x), np.concatenate(carry_y)
         finally:
             stop.set()
-            # drain so a blocked loader thread can exit
-            try:
-                while True:
-                    q.get_nowait()
-            except queue.Empty:
-                pass
+            # drain so a blocked loader thread can exit, then JOIN it —
+            # an abandoned iterator (elastic reset, user break) must not
+            # leave a zombie loader reading shards against the next
+            # world's epoch (errflow leak-on-raise audit). The loader can
+            # re-fill freed slots before it sees the stop event, so drain
+            # and join alternate until it exits.
+            deadline = time.monotonic() + 5.0
+            while t.is_alive() and time.monotonic() < deadline:
+                try:
+                    while True:
+                        q.get_nowait()
+                except queue.Empty:
+                    pass
+                t.join(timeout=0.05)

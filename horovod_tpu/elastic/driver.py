@@ -339,6 +339,7 @@ class ElasticDriver:
     def resume(self):
         """Rebuild the world (reference driver.py:108-116). Runs in a fresh
         thread because it is called from registry barriers."""
+        # errflow: ignore[resume continuation: joining here would deadlock the registry barrier that triggered it; its failure path calls stop(error), which wait_for_finished() observes]
         threading.Thread(target=self._resume_inner, daemon=True).start()
 
     def _resume_inner(self):

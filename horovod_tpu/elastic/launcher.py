@@ -92,6 +92,7 @@ def launch_elastic_job(discovery, np: int, command: List[str],
                                            index=slot.local_rank)
             driver.record_worker_exit(slot.hostname, slot.local_rank, code)
 
+        # errflow: ignore[worker-monitor lifetime equals the worker process; record_worker_exit feeds the driver accounting that wait_for_finished()/join() gate shutdown on]
         threading.Thread(target=_monitor, daemon=True,
                          name=f"worker-{slot.hostname}:{slot.local_rank}"
                          ).start()

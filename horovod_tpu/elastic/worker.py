@@ -172,6 +172,7 @@ class WorkerNotificationManager:
         try:
             retrying(_attempt, attempts=4, base_delay=0.1, max_delay=2.0,
                      deadline=30.0, op="reregister")
+        # errflow: ignore[final-failure degraded mode by design: WARNING + the retrying() gave-up counter; the worker trains on and re-advertises at the next reset]
         except Exception as e:
             _LOG.warning(
                 "notification re-registration for rank %s at %s failed "

@@ -510,7 +510,8 @@ class RendezvousServer(KVStoreServer):
 
 def find_free_port(bind: str = "") -> int:
     s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    s.bind((bind, 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    try:
+        s.bind((bind, 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()

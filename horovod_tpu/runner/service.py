@@ -174,6 +174,11 @@ class TaskService:
     def stop(self):
         self._httpd.shutdown()
         self._httpd.server_close()
+        # join the serve thread so no zombie handler races whatever the
+        # agent does next (errflow leak-on-raise audit)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
 
     @property
     def port(self) -> int:
@@ -214,6 +219,7 @@ class TaskService:
                     self._exit_code = code
                     self._proc_pid = None
 
+            # errflow: ignore[the command deliberately outlives the RPC that started it; abort_command owns termination and exit codes are polled via command_exit_code]
             self._cmd_thread = threading.Thread(target=_runner, daemon=True,
                                                 name="hvd-task-cmd")
             self._cmd_thread.start()

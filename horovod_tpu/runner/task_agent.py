@@ -66,6 +66,7 @@ def main(argv=None) -> int:
                 except Exception:
                     stop.wait(2.0)
 
+        # errflow: ignore[best-effort bounded advertisement retry; exits on the agent stop event that also gates process exit]
         threading.Thread(target=_register, daemon=True).start()
 
     signal.signal(signal.SIGTERM, lambda *a: stop.set())

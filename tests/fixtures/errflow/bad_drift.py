@@ -1,0 +1,14 @@
+"""errflow fixture: FAULT_SPECS vs failpoint() drift, both directions."""
+from horovod_tpu.faults import failpoint
+
+FAULT_SPECS = {
+    "ok.placed": "a declared and placed failpoint",
+    "dead.name": "declared but unplaced",  # VIOLATION: dead declaration
+}
+
+
+def f(name):
+    failpoint("ok.placed")
+    failpoint("un.declared")  # VIOLATION: undeclared name
+    failpoint("test.reserved")  # VIOLATION: reserved prefix
+    failpoint(name)  # VIOLATION: computed name

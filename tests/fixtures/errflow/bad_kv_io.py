@@ -1,0 +1,28 @@
+"""errflow fixture: raw transport calls with neither a deadline nor a
+``retrying()`` wrapper."""
+import socket
+import urllib.request
+
+from horovod_tpu.common.retry import retrying
+
+
+def no_deadline(url):
+    return urllib.request.urlopen(url)  # VIOLATION: deadline-less urlopen
+
+
+def sock_no_deadline(addr):
+    conn = socket.create_connection(addr)  # VIOLATION: deadline-less connect
+    try:
+        return conn.recv(1)
+    finally:
+        conn.close()
+
+
+def with_deadline(url):
+    return urllib.request.urlopen(url, timeout=5)
+
+
+def wrapped(url):
+    def _attempt():
+        return urllib.request.urlopen(url)  # retrying()-owned: not flagged
+    return retrying(_attempt, attempts=3, deadline=10.0)

@@ -1,0 +1,47 @@
+"""errflow fixture: resources whose release is missing from the
+exception edge (files/sockets) or from any shutdown path (threads)."""
+import socket
+import threading
+
+
+def success_path_close(path, sink):
+    f = open(path)  # VIOLATION: closed only on the success path
+    sink.write(f.read())
+    f.close()
+
+
+def never_closed(path, sink):
+    f = open(path)  # VIOLATION: never closed
+    sink.write(f.read())
+
+
+def socket_success_close(addr):
+    s = socket.socket()  # VIOLATION: bind may raise before close
+    s.bind(addr)
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def local_thread_no_join(job):
+    t = threading.Thread(target=job)  # VIOLATION: started, never joined
+    t.start()
+
+
+def fire_and_forget(job):
+    threading.Thread(target=job, daemon=True).start()  # VIOLATION: untracked
+
+
+class NoJoinWorker:
+    def start(self, job):
+        self._t = threading.Thread(target=job)  # VIOLATION: no method joins
+        self._t.start()
+
+
+class JoinedWorker:
+    def start(self, job):
+        self._t = threading.Thread(target=job)
+        self._t.start()
+
+    def stop(self):
+        self._t.join(timeout=5)

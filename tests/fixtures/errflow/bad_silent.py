@@ -1,0 +1,46 @@
+"""errflow fixture: except blocks on declared seams that are neither
+observable nor propagating."""
+import logging
+
+from horovod_tpu.faults import failpoint
+
+logger = logging.getLogger(__name__)
+
+
+def silent_failpoint_seam(kv, payload):
+    failpoint("pub.send")
+    try:
+        kv.put(payload)
+    except Exception:
+        payload.dropped = True  # VIOLATION: silent degraded mode
+
+
+# errflow: seam[degraded KV write path declared without a failpoint]
+def silent_tagged_seam(kv, payload):
+    try:
+        kv.put(payload)
+    except Exception:
+        pass  # VIOLATION: silent tagged seam
+
+
+def warning_seam(kv, payload):
+    failpoint("pub.warned")
+    try:
+        kv.put(payload)
+    except Exception as e:
+        logger.warning("publish failed: %s", e)  # observable: not flagged
+
+
+def counted_seam(kv, payload, counter):
+    failpoint("pub.counted")
+    try:
+        kv.put(payload)
+    except OSError:
+        counter.inc()  # observable: not flagged
+
+
+def not_a_seam(kv, payload):
+    try:
+        kv.put(payload)
+    except Exception:
+        payload.dropped = True  # no seam declared: outside this class

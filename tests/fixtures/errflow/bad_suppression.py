@@ -1,0 +1,22 @@
+"""errflow fixture: suppression hygiene — reasonless and stale
+suppressions are themselves findings; a reasoned one is enumerated."""
+
+
+def synchronize(work):
+    try:
+        work()
+    except Exception:  # errflow: ignore[]
+        work.done = True  # BAD: suppression without a reason
+
+
+def _dispatch(work):
+    try:
+        work()
+    # errflow: ignore[fixture: deliberate best-effort swallow, reasoned]
+    except Exception:
+        work.done = True  # suppressed OK — enumerated in the report
+
+
+# errflow: ignore[stale: the code this excused is gone]
+def clean_helper(x):
+    return x

@@ -1,0 +1,109 @@
+"""errflow fixture: swallowed recovery-class errors on the recovery
+path — and the sanctioned shapes that must NOT be flagged."""
+
+
+class Handle:
+    def synchronize(self):  # recovery root
+        try:
+            self._wait()
+        except Exception:
+            self.done = True  # VIOLATION: swallowed broad except
+
+    def _wait(self):
+        raise RuntimeError("boom")
+
+
+def _dispatch(work, helper_on_path):  # recovery root
+    try:
+        work()
+    except BaseException:
+        work.failed = True  # VIOLATION: swallowed BaseException
+    try:
+        work()
+    except HorovodInternalError:  # noqa: F821 — name-level fixture
+        work.count = 1  # VIOLATION: swallowed recovery carrier
+    helper_on_path()
+    reraise_ok(work)
+    return_ok(work)
+    escalate_ok(work, work)
+    later_raise_ok(work)
+    probe_ok()
+    tail_ok(work)
+    loop_ok(work)
+
+
+def helper_on_path():
+    try:
+        step()  # noqa: F821
+    except Exception:
+        state = "degraded"  # noqa: F841  VIOLATION: reachable helper swallows
+    print(state)  # noqa: F821
+
+
+def reraise_ok(work):
+    try:
+        work()
+    except Exception:
+        raise
+
+
+def return_ok(work):
+    try:
+        work()
+    except Exception:
+        return None
+
+
+def escalate_ok(work, engine):
+    try:
+        work()
+    except Exception as e:
+        engine.poison(e)
+
+
+def later_raise_ok(work):
+    last = None
+    for _ in range(3):
+        try:
+            work()
+            break
+        except Exception as e:
+            last = e
+    if last is not None:
+        raise last
+
+
+def probe_ok():
+    try:
+        import does_not_exist_anywhere  # noqa: F401
+    except Exception:
+        pass
+
+
+def tail_ok(work):
+    ok = False
+    try:
+        work()
+        ok = True
+    except Exception:
+        ok = False
+    return ok
+
+
+def loop_ok(work):
+    while True:
+        if work.expired:
+            raise TimeoutError("deadline")
+        try:
+            return work()
+        except Exception as e:
+            work.last = e
+
+
+def off_path_helper(work):
+    """NOT reachable from any recovery root: a broad swallow here is
+    outside this finding class (lifecycle/seam rules still apply)."""
+    try:
+        work()
+    except Exception:
+        pass

@@ -1,0 +1,96 @@
+"""errflow fixture: every pattern here is sanctioned — zero findings.
+
+Recovery-path handlers that re-raise/return/escalate, deadline-carrying
+transport calls, context-managed resources, joined threads, observable
+seams, and a drift-free failpoint registry.
+"""
+import logging
+import socket
+import threading
+import urllib.request
+
+from horovod_tpu.common.retry import retrying
+
+logger = logging.getLogger(__name__)
+
+FAULT_SPECS = {
+    "clean.publish": "the one declared-and-placed failpoint",
+}
+
+
+def synchronize(handle):
+    """A recovery root whose broad except re-raises: propagation OK."""
+    try:
+        return handle.wait()
+    except Exception:
+        handle.teardown()
+        raise
+
+
+def _dispatch(work, engine):
+    """Escalation counts as propagation."""
+    try:
+        work()
+    except Exception as e:
+        engine.poison(e)
+
+
+def fetch_with_deadline(url):
+    return urllib.request.urlopen(url, timeout=5)
+
+
+def fetch_retry_wrapped(url):
+    def _attempt():
+        return urllib.request.urlopen(url)
+    return retrying(_attempt, attempts=3, deadline=10.0)
+
+
+def probe(addr):
+    with socket.create_connection(addr, timeout=2):
+        return True
+
+
+def read_config(path):
+    with open(path) as f:
+        return f.read()
+
+
+def read_finally(path):
+    f = open(path)
+    try:
+        return f.read()
+    finally:
+        f.close()
+
+
+def open_for_caller(path):
+    f = open(path)
+    return f  # ownership transfer: the caller owns the close
+
+
+def run_workers(jobs):
+    threads = [threading.Thread(target=j) for j in jobs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+class Publisher:
+    def __init__(self, target):
+        self._thread = threading.Thread(target=target, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._thread.join(timeout=5)
+
+
+def observable_publish(kv, payload, counter):
+    """A declared seam whose degraded mode is counted: observable."""
+    from horovod_tpu.faults import failpoint
+    failpoint("clean.publish")
+    try:
+        kv.put(payload)
+    except Exception as e:
+        counter.inc()
+        logger.warning("publish failed: %s", e)

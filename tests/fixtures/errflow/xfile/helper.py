@@ -1,0 +1,15 @@
+"""errflow cross-file fixture: reachable from runloop.py's ``run_fn``."""
+
+
+def fetch_state(state):
+    try:
+        state.load()
+    except Exception:
+        state.cached = True  # VIOLATION: cross-file swallow
+
+
+def unreached(state):
+    try:
+        state.load()
+    except Exception:
+        state.cached = True  # not reachable from the root: not flagged

@@ -1,0 +1,10 @@
+"""errflow cross-file fixture: the recovery root lives here; the
+swallow it reaches lives in helper.py (the call graph is name-resolved
+across every file of the run)."""
+
+
+def run_fn(func, reset):
+    def wrapper(state):
+        fetch_state(state)  # noqa: F821 — resolved by name across files
+        return func(state)
+    return wrapper

@@ -31,7 +31,7 @@ def _load_check():
 
 
 LINTS = ("lockcheck", "divcheck", "knobs", "metrics", "faults",
-         "trace_schema", "ckpt_manifest")
+         "trace_schema", "ckpt_manifest", "errflow")
 
 
 @pytest.mark.parametrize("lint", LINTS)
@@ -63,7 +63,7 @@ def test_cli_json_report(capsys):
         assert res["ok"] and res["errors"] == [], name
 
 
-@pytest.mark.parametrize("lint", ("lockcheck", "divcheck"))
+@pytest.mark.parametrize("lint", ("lockcheck", "divcheck", "errflow"))
 def test_suppressions_all_explained(lint):
     """Acceptance criterion: zero unexplained ``<lint>: ignore``
     suppressions under horovod_tpu/ — the JSON report carries each with
@@ -87,6 +87,33 @@ def test_divcheck_agreed_sites_all_documented():
         assert a["how"] and a["how"].strip(), a
 
 
+def test_errflow_seams_all_documented():
+    """Every errflow seam (failpoint-implicit or ``errflow: seam``
+    tagged) is enumerated in the report with a non-empty 'how'."""
+    check = _load_check()
+    report = check.run_checks(only=["errflow"])
+    seams = report["checks"]["errflow"]["stats"]["seams"]
+    assert seams, "the live tree is expected to carry declared seams"
+    for s in seams:
+        assert s["how"] and s["how"].strip(), s
+
+
+def test_faults_does_not_double_report_site_drift():
+    """errflow owns failpoint call-site drift (failpoint-drift); the
+    faults lint surfaces sites only as stats — one violation must turn
+    exactly one lint red, not two."""
+    from horovod_tpu.analysis import faultcheck
+    errs = faultcheck.validate_call_sites(
+        {"ok.name": "declared"}, [("x.py", 3, "engine.bogus")])
+    assert errs and "engine.bogus" in errs[0]   # the rule still exists...
+    check = _load_check()
+    report = check.run_checks(only=["faults"])
+    stats = report["checks"]["faults"]["stats"]
+    assert stats["site_drift"] == []            # ...but clean-tree run()
+    # demotes it to a stat: drift errors come from errflow alone
+    assert report["checks"]["faults"]["ok"]
+
+
 def test_changed_mode_runs_pure_ast_lints():
     """``--changed`` selects the pure-AST subset and filters file-scoped
     findings to the changed set (empty set -> trivially clean, but the
@@ -94,10 +121,12 @@ def test_changed_mode_runs_pure_ast_lints():
     check = _load_check()
     report = check.run_checks(changed=set())
     assert set(report["checks"]) == set(check.CHANGED_MODE_LINTS)
-    div = report["checks"]["divcheck"]
-    assert div["ok"] and div["errors"] == []
-    assert div["stats"]["files"] >= 60          # whole-tree scan, not subset
-    assert div["stats"]["changed_files"] == 0
+    assert "errflow" in report["checks"]       # ISSUE 15: lint #8 rides it
+    for lint in ("divcheck", "errflow"):
+        res = report["checks"][lint]
+        assert res["ok"] and res["errors"] == []
+        assert res["stats"]["files"] >= 60     # whole-tree scan, not subset
+        assert res["stats"]["changed_files"] == 0
 
 
 def test_changed_mode_filters_findings_to_changed_files():
@@ -110,6 +139,9 @@ def test_changed_mode_filters_findings_to_changed_files():
     # runner directly: a bogus changed set yields zero errors AND the
     # changed_files stat proves the filter was applied
     errors, stats = check.run_divcheck(changed={"horovod_tpu/faults.py"})
+    assert errors == []
+    assert stats["changed_files"] == 1
+    errors, stats = check.run_errflow(changed={"horovod_tpu/faults.py"})
     assert errors == []
     assert stats["changed_files"] == 1
 
@@ -151,7 +183,7 @@ def test_single_lint_shims_still_work():
         [sys.executable, os.path.join(TOOLS, script)],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         for script in ("check_metric_names.py", "check_fault_names.py",
-                       "lockcheck.py", "divcheck.py")}
+                       "lockcheck.py", "divcheck.py", "errflow.py")}
     for script, proc in procs.items():
         out, err = proc.communicate(timeout=300)
         assert proc.returncode == 0, f"{script}: {out}{err}"

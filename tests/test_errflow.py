@@ -1,0 +1,461 @@
+"""The exception-propagation & resource-lifecycle analyzer (ISSUE 15
+tentpole): every finding class must be detected with file:line on the
+known fixtures, the clean fixture must produce zero findings, and the
+live ``horovod_tpu/`` tree must be clean with every suppression
+carrying its reason and every seam enumerated.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from horovod_tpu.analysis import errflow
+
+pytestmark = pytest.mark.lint
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "errflow")
+PKG_ROOT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "horovod_tpu")
+
+
+def _check_fixture(name):
+    path = os.path.join(FIXTURES, name)
+    rep = errflow.check_paths([path], root=FIXTURES)
+    lines = []
+    if os.path.isfile(path):
+        lines = open(path).read().splitlines()
+    return rep, lines
+
+
+def _line_of(lines, needle, nth=0):
+    hits = [i + 1 for i, l in enumerate(lines) if needle in l]
+    assert hits, f"fixture drifted: {needle!r} not found"
+    return hits[nth]
+
+
+def _src(s):
+    return textwrap.dedent(s)
+
+
+# ---------------------------------------------------------------------------
+# finding classes, asserted by file:line on the fixtures
+# ---------------------------------------------------------------------------
+
+class TestViolationClasses:
+    def test_swallowed_recovery_error(self):
+        rep, lines = _check_fixture("bad_swallow.py")
+        got = {(f.check, f.line) for f in rep.findings}
+        for marker in ("VIOLATION: swallowed broad except",
+                       "VIOLATION: swallowed BaseException",
+                       "VIOLATION: swallowed recovery carrier",
+                       "VIOLATION: reachable helper swallows"):
+            # the finding anchors to the except line directly above the
+            # marked handler body
+            line = _line_of(lines, marker) - 1
+            assert ("swallowed-recovery-error", line) in got, marker
+        # reraise/return/escalate/later-raise/import-probe/tail-signal/
+        # loop-back-edge and the off-path helper are all sanctioned
+        assert len(rep.findings) == 4
+
+    def test_unretried_kv_io(self):
+        rep, lines = _check_fixture("bad_kv_io.py")
+        got = {(f.check, f.line) for f in rep.findings}
+        for marker in ("VIOLATION: deadline-less urlopen",
+                       "VIOLATION: deadline-less connect"):
+            assert ("unretried-kv-io", _line_of(lines, marker)) in got
+        # timeout= and retrying()-wrapped calls are sanctioned
+        assert len(rep.findings) == 2
+
+    def test_leak_on_raise(self):
+        rep, lines = _check_fixture("bad_leak.py")
+        got = {(f.check, f.line) for f in rep.findings}
+        for marker in ("VIOLATION: closed only on the success path",
+                       "VIOLATION: never closed",
+                       "VIOLATION: bind may raise before close",
+                       "VIOLATION: started, never joined",
+                       "VIOLATION: untracked",
+                       "VIOLATION: no method joins"):
+            assert ("leak-on-raise", _line_of(lines, marker)) in got, marker
+        # JoinedWorker (class-level join) is sanctioned
+        assert len(rep.findings) == 6
+
+    def test_silent_error_path(self):
+        rep, lines = _check_fixture("bad_silent.py")
+        got = {(f.check, f.line) for f in rep.findings}
+        for marker in ("VIOLATION: silent degraded mode",
+                       "VIOLATION: silent tagged seam"):
+            line = _line_of(lines, marker) - 1
+            assert ("silent-error-path", line) in got, marker
+        # WARNING-logging / counter-incrementing seams and undeclared
+        # defs are sanctioned
+        assert len(rep.findings) == 2
+        # every seam (failpoint-implicit + tagged) is enumerated
+        assert {s.func for s in rep.seams} == {
+            "silent_failpoint_seam", "silent_tagged_seam",
+            "warning_seam", "counted_seam"}
+
+    def test_failpoint_drift_both_directions(self):
+        rep, lines = _check_fixture("bad_drift.py")
+        got = {(f.check, f.line) for f in rep.findings}
+        for marker in ("VIOLATION: dead declaration",
+                       "VIOLATION: undeclared name",
+                       "VIOLATION: reserved prefix",
+                       "VIOLATION: computed name"):
+            assert ("failpoint-drift", _line_of(lines, marker)) in got
+        assert len(rep.findings) == 4
+        assert rep.failpoints_declared == 2
+        assert rep.failpoint_sites == 4
+
+    def test_suppression_hygiene(self):
+        rep, lines = _check_fixture("bad_suppression.py")
+        got = {(f.check, f.line) for f in rep.findings}
+        assert ("bad-suppression",
+                _line_of(lines, "errflow: ignore[]")) in got
+        assert ("stale-suppression",
+                _line_of(lines, "stale: the code this excused")) in got
+        assert len(rep.findings) == 2
+        assert len(rep.suppressions) == 1
+        s = rep.suppressions[0]
+        assert s.check == "swallowed-recovery-error"
+        assert "reasoned" in s.reason
+
+    def test_cross_file_propagation(self):
+        """The recovery footprint resolves across files: run_fn in
+        runloop.py reaches the swallow in helper.py; the unreached def
+        is not flagged."""
+        rep, _ = _check_fixture("xfile")
+        helper = open(os.path.join(FIXTURES, "xfile",
+                                   "helper.py")).read().splitlines()
+        assert len(rep.findings) == 1
+        f = rep.findings[0]
+        assert f.check == "swallowed-recovery-error"
+        assert f.file == os.path.join("xfile", "helper.py")
+        assert f.line == _line_of(helper, "VIOLATION: cross-file swallow") - 1
+
+    def test_clean_fixture_zero_findings(self):
+        rep, _ = _check_fixture("clean.py")
+        assert rep.findings == []
+        assert rep.suppressions == []
+        # the observable seam is still discovered and enumerated
+        assert [s.func for s in rep.seams] == ["observable_publish"]
+
+
+# ---------------------------------------------------------------------------
+# convention units (in-memory sources)
+# ---------------------------------------------------------------------------
+
+class TestConventions:
+    def test_trailing_suppression_does_not_bleed(self):
+        """A trailing ignore covers its own line only; the next line's
+        finding survives."""
+        rep = errflow.check_source(_src("""
+            def synchronize(a, b):
+                try:
+                    a()
+                except Exception:  # errflow: ignore[first swallow is deliberate]
+                    a.done = True
+                try:
+                    b()
+                except Exception:
+                    b.done = True
+        """))
+        assert len(rep.findings) == 1
+        assert rep.findings[0].line == 9
+        assert len(rep.suppressions) == 1
+
+    def test_standalone_suppression_covers_line_below(self):
+        rep = errflow.check_source(_src("""
+            def synchronize(a):
+                try:
+                    a()
+                # errflow: ignore[deliberate]
+                except Exception:
+                    a.done = True
+        """))
+        assert rep.findings == []
+        assert len(rep.suppressions) == 1
+
+    def test_seam_tag_standalone_above_def(self):
+        rep = errflow.check_source(_src("""
+            # errflow: seam[declared degraded path]
+            def push(kv, v):
+                try:
+                    kv.put(v)
+                except Exception:
+                    v.dropped = True
+        """))
+        assert [f.check for f in rep.findings] == ["silent-error-path"]
+        assert rep.seams[0].how == "declared degraded path"
+
+    def test_handler_return_and_raise_propagate(self):
+        rep = errflow.check_source(_src("""
+            def synchronize(a, b):
+                try:
+                    a()
+                except Exception:
+                    return None
+                try:
+                    b()
+                except Exception as e:
+                    raise RuntimeError("wrapped") from e
+        """))
+        assert rep.findings == []
+
+    def test_bound_error_raised_later_propagates(self):
+        rep = errflow.check_source(_src("""
+            def synchronize(work):
+                last = None
+                for _ in range(3):
+                    try:
+                        return work()
+                    except Exception as e:
+                        last = e
+                raise last
+        """))
+        assert rep.findings == []
+
+    def test_tail_return_after_try_propagates(self):
+        rep = errflow.check_source(_src("""
+            def synchronize(work):
+                ok = True
+                try:
+                    work()
+                except Exception:
+                    ok = False
+                return ok
+        """))
+        assert rep.findings == []
+
+    def test_loop_back_edge_raise_propagates(self):
+        """The long-poll idiom: the deadline raise at the TOP of the
+        while body is reachable from the handler via the back edge."""
+        rep = errflow.check_source(_src("""
+            def synchronize(work, expired):
+                while True:
+                    if expired():
+                        raise TimeoutError("deadline")
+                    try:
+                        return work()
+                    except Exception as e:
+                        work.last = e
+        """))
+        assert rep.findings == []
+
+    def test_retry_loop_guarded_raise_is_no_signal(self):
+        """A raise INSIDE the try body does not exempt the broad handler
+        around it in a loop — the handler re-swallows it every
+        iteration (infinite silent retry, the exact bug class)."""
+        rep = errflow.check_source(_src("""
+            def _dispatch(work):
+                while True:
+                    try:
+                        if work.bad:
+                            raise RuntimeError("fault")
+                        work()
+                    except Exception:
+                        pass
+        """))
+        assert [f.check for f in rep.findings] == ["swallowed-recovery-error"]
+
+    def test_sibling_narrow_clause_does_not_vouch_for_broad(self):
+        """A re-raise in a sibling ``except ValueError`` runs only for
+        ValueErrors — it cannot excuse the broad swallow next to it."""
+        rep = errflow.check_source(_src("""
+            def _dispatch(work):
+                while True:
+                    try:
+                        work()
+                    except ValueError:
+                        raise
+                    except Exception:
+                        pass
+        """))
+        assert [f.check for f in rep.findings] == ["swallowed-recovery-error"]
+
+    def test_positional_timeout_is_deadlined(self):
+        """``create_connection(addr, 5.0)`` — timeout as the documented
+        second positional — is a deadlined call; the same call with the
+        address alone is not."""
+        rep = errflow.check_source(_src("""
+            import socket
+
+            def deadlined(addr):
+                return socket.create_connection(addr, 5.0)
+
+            def bare(addr):
+                return socket.create_connection(addr)
+        """))
+        assert [(f.check, f.func) for f in rep.findings] == [
+            ("unretried-kv-io", "bare")]
+
+    def test_import_probe_exempt(self):
+        rep = errflow.check_source(_src("""
+            def synchronize(errs):
+                try:
+                    import optional_dep
+                    errs.append(optional_dep.Error)
+                except Exception:
+                    pass
+        """))
+        assert rep.findings == []
+
+    def test_nested_def_handler_checked_in_own_context(self):
+        """A later raise in the OUTER def does not excuse a swallow
+        inside a nested closure."""
+        rep = errflow.check_source(_src("""
+            def synchronize(work):
+                def inner():
+                    try:
+                        work()
+                    except Exception:
+                        work.done = True
+                inner()
+                raise RuntimeError("outer tail")
+        """))
+        assert [f.check for f in rep.findings] == ["swallowed-recovery-error"]
+
+    def test_narrow_except_not_flagged_on_recovery_path(self):
+        rep = errflow.check_source(_src("""
+            def synchronize(work):
+                try:
+                    work()
+                except OSError:
+                    work.done = True
+        """))
+        assert rep.findings == []
+
+    def test_retrying_exemption_for_io(self):
+        rep = errflow.check_source(_src("""
+            import urllib.request
+            from horovod_tpu.common.retry import retrying
+
+            def fetch(url):
+                def _attempt():
+                    return urllib.request.urlopen(url)
+                return retrying(_attempt, attempts=2)
+        """))
+        assert rep.findings == []
+
+    def test_with_managed_resources_clean(self):
+        rep = errflow.check_source(_src("""
+            import socket
+
+            def read(path, addr):
+                with open(path) as f, \\
+                        socket.create_connection(addr, timeout=1) as s:
+                    s.send(f.read())
+        """))
+        assert rep.findings == []
+
+    def test_thread_joined_by_sibling_method_via_base_class(self):
+        """Release methods merge over same-file bases: a subclass of a
+        joining server is covered."""
+        rep = errflow.check_source(_src("""
+            import threading
+
+            class Server:
+                def stop(self):
+                    self._thread.join(timeout=5)
+
+            class KVServer(Server):
+                def start(self, target):
+                    self._thread = threading.Thread(target=target)
+                    self._thread.start()
+        """))
+        assert rep.findings == []
+
+    def test_parse_error_reported_not_crash(self):
+        rep = errflow.check_source("def broken(:\n")
+        assert [f.check for f in rep.findings] == ["parse-error"]
+
+    def test_check_sources_cross_file(self):
+        rep = errflow.check_sources({
+            "a.py": _src("""
+                def _dispatch(x):
+                    helper(x)
+            """),
+            "b.py": _src("""
+                def helper(x):
+                    try:
+                        x()
+                    except Exception:
+                        x.done = True
+            """),
+        })
+        assert len(rep.findings) == 1
+        assert rep.findings[0].file == "b.py"
+
+    def test_no_propagate_names_block_reachability(self):
+        """A bare .run() call edge must not drag every def named run
+        onto the recovery path."""
+        rep = errflow.check_sources({
+            "a.py": _src("""
+                def _dispatch(x):
+                    x.run()
+            """),
+            "b.py": _src("""
+                def run(x):
+                    try:
+                        x()
+                    except Exception:
+                        x.done = True
+            """),
+        })
+        assert rep.findings == []
+
+
+# ---------------------------------------------------------------------------
+# live-tree keep-honest floors
+# ---------------------------------------------------------------------------
+
+class TestLiveTree:
+    @pytest.fixture(scope="class")
+    def live(self):
+        return errflow.check_package(PKG_ROOT)
+
+    def test_live_tree_clean(self, live):
+        assert live.findings == [], "\n".join(str(f) for f in live.findings)
+
+    def test_scan_coverage_floors(self, live):
+        """A gutted collector cannot go green: the scan must actually
+        cover the tree (counts at HEAD: 83 files, ~1160 defs, ~310
+        recovery-path defs, ~170 handlers, 24 seams)."""
+        assert live.files >= 60
+        assert live.defs >= 900
+        assert live.recovery_defs >= 150
+        assert live.handlers >= 120
+        assert len(live.seams) >= 15
+        assert live.failpoints_declared >= 15
+        assert live.failpoint_sites >= 20
+
+    def test_all_suppressions_reasoned(self, live):
+        assert live.suppressions, \
+            "the annotated tree is expected to carry suppressions"
+        for s in live.suppressions:
+            assert s.reason and s.reason.strip(), s.to_dict()
+
+    def test_known_fixed_violations_stay_fixed(self, live):
+        """The ISSUE 15 sweep fixes: the cycle-loop join, the task-
+        service join, the data-loader join, and the find_free_port
+        socket lifecycle must not regress (they would reappear as
+        findings, caught by test_live_tree_clean — this pins the
+        specific files so a suppression can't hide a regression)."""
+        for rel in ("horovod_tpu/core/engine.py",
+                    "horovod_tpu/runner/http_server.py",
+                    "horovod_tpu/data.py"):
+            leaks = [s for s in live.suppressions
+                     if s.file == rel and s.check == "leak-on-raise"]
+            assert not leaks, f"{rel}: fixed leak re-suppressed: {leaks}"
+        assert "horovod_tpu/runner/service.py" not in {
+            s.file for s in live.suppressions
+            if "self._thread" in s.message}
+
+    def test_report_json_round_trip(self, live):
+        d = live.to_dict()
+        assert d["ok"] is True
+        assert isinstance(d["suppressions"], list)
+        for s in d["suppressions"]:
+            assert {"check", "file", "line", "reason"} <= set(s)
+        for s in d["seams"]:
+            assert {"file", "line", "func", "how"} <= set(s)

@@ -1,0 +1,152 @@
+"""Regression tests for the true violations the ISSUE 15 errflow sweep
+found and fixed (the PR 7/11 bar: each test fails against the pre-fix
+code — verified by swapping the HEAD implementation back in).
+
+1. ``Engine.stop()`` set a flag and returned while the cycle thread
+   slept out its full cycle time — an elastic teardown left a zombie
+   cycle loop retiring handles while the next world's engine spun up.
+   Now the loop is Event-paced and ``stop()`` joins it.
+2. ``ShardBatchIterator.__iter__`` abandoned its loader thread on exit:
+   the ``finally`` drained the queue but never joined, so an elastic
+   reset (or a plain ``break``) left a loader reading shards against
+   the next world's epoch. Now the finally drains AND joins.
+3. ``find_free_port`` leaked its probe socket when ``bind`` raised
+   (exhausted ephemeral range, EPERM sandboxes): ``close()`` sat on the
+   success path only. Now ``try/finally``.
+4. ``TaskService.stop()`` shut the HTTP server down but never joined
+   the serve thread. Now it joins (asserted via the public stop path).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.lint
+
+
+def _threads_named(name):
+    return [t for t in threading.enumerate() if t.name == name]
+
+
+class TestEngineCycleThreadJoin:
+    def test_stop_joins_cycle_loop(self, monkeypatch):
+        """Pre-fix: stop() only flipped a flag read AFTER a
+        time.sleep(cycle_time) — with a 2 s cycle the thread was still
+        alive (sleeping) when stop() returned, deterministically. Post-
+        fix: the Event wait is woken and the thread joined before
+        stop() returns."""
+        # a long cycle makes the pre-fix zombie window deterministic;
+        # the Event-paced fix wakes immediately, so the test stays fast
+        monkeypatch.setenv("HOROVOD_CYCLE_TIME", "2000")
+        import horovod_tpu as hvd
+        hvd.init()
+        try:
+            from horovod_tpu.core.state import global_state
+            eng = global_state().engine
+            assert eng is not None
+            cycle = eng._cycle_thread
+            assert cycle.is_alive()
+            t0 = time.monotonic()
+            eng.stop()
+            assert not cycle.is_alive(), (
+                "Engine.stop() returned with the cycle thread still "
+                "running — the pre-fix zombie")
+            # and it must not have waited out the 2 s sleep to do it
+            assert time.monotonic() - t0 < 1.5
+        finally:
+            hvd.shutdown()
+
+
+class TestDataLoaderJoin:
+    def test_abandoned_iterator_joins_loader(self, tmp_path):
+        """Pre-fix: closing the iterator drained the queue and returned
+        with the loader thread still loading the next shard — a zombie
+        'hvd-data-loader' survived the iterator. Post-fix: the finally
+        joins it."""
+        from horovod_tpu.data import ShardBatchIterator
+        paths = []
+        for i in range(6):
+            p = tmp_path / f"shard{i}.npz"
+            np.savez(p, x=np.zeros((64, 4), np.float32),
+                     y=np.zeros((64,), np.int32))
+            paths.append(str(p))
+        ds = ShardBatchIterator(paths, batch_size=8, shuffle=False,
+                                prefetch=1)
+        it = iter(ds)
+        next(it)               # loader is now racing ahead of the consumer
+        it.close()             # abandon mid-stream (elastic reset / break)
+        leftovers = _threads_named("hvd-data-loader")
+        assert not any(t.is_alive() for t in leftovers), (
+            "iterator close() left a live loader thread — the pre-fix "
+            "zombie")
+
+
+class TestFindFreePortSocketLifecycle:
+    def test_socket_closed_when_bind_raises(self, monkeypatch):
+        """Pre-fix: close() ran after bind/getsockname on the straight
+        line, so a bind failure leaked the probe socket. Post-fix: the
+        finally closes it on the exception edge too."""
+        from horovod_tpu.runner import http_server
+
+        closed = []
+
+        class _BoomSocket:
+            def __init__(self, *a, **k):
+                pass
+
+            def bind(self, addr):
+                raise OSError("injected bind failure")
+
+            def getsockname(self):  # pragma: no cover — bind raises first
+                return ("", 0)
+
+            def close(self):
+                closed.append(True)
+
+        monkeypatch.setattr(http_server.socket, "socket", _BoomSocket)
+        with pytest.raises(OSError, match="injected bind failure"):
+            http_server.find_free_port()
+        assert closed, (
+            "find_free_port leaked its socket on the bind-failure edge "
+            "— the pre-fix leak")
+
+    def test_still_returns_a_port(self):
+        port = __import__(
+            "horovod_tpu.runner.http_server",
+            fromlist=["find_free_port"]).find_free_port()
+        assert 0 < port < 65536
+
+
+class TestTaskServiceThreadJoin:
+    def test_stop_joins_serve_thread(self):
+        from horovod_tpu.runner.service import TaskService
+        svc = TaskService(key=b"secret", addr=("127.0.0.1", 0))
+        svc.start()
+        thread = svc._thread
+        assert thread is not None and thread.is_alive()
+        svc.stop()
+        assert not thread.is_alive()
+        assert svc._thread is None
+
+
+@pytest.mark.skipif(os.environ.get("HOROVOD_SKIP_SLOW") == "1",
+                    reason="explicitly skipped")
+class TestLoaderJoinBoundsShutdown:
+    def test_join_is_bounded(self, tmp_path):
+        """The drain+join loop is deadline-bounded: even a loader mid-
+        np.load exits promptly once the queue drains (no unbounded
+        shutdown hang was introduced by the fix)."""
+        from horovod_tpu.data import ShardBatchIterator
+        p = tmp_path / "one.npz"
+        np.savez(p, x=np.zeros((1024, 8), np.float32),
+                 y=np.zeros((1024,), np.int32))
+        ds = ShardBatchIterator([str(p)] * 4, batch_size=16,
+                                shuffle=False, prefetch=1)
+        it = iter(ds)
+        next(it)
+        t0 = time.monotonic()
+        it.close()
+        assert time.monotonic() - t0 < 5.5

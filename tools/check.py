@@ -1,9 +1,11 @@
 """Unified static-analysis driver: every lint, one command, one report.
 
-Runs the seven analysis passes the repo has accumulated (PRs 3-5 grew
+Runs the eight analysis passes the repo has accumulated (PRs 3-5 grew
 one script per namespace; ISSUE 7 consolidated them and added the
 concurrency lints; ISSUE 9 added the checkpoint-manifest contract;
-ISSUE 11 added the SPMD divergence checker):
+ISSUE 11 added the SPMD divergence checker; ISSUE 15 added the
+error-flow analyzer and folded the name lints into
+``horovod_tpu/analysis/``):
 
 - ``lockcheck``     — GUARDED_BY lock-discipline checker over
                       ``horovod_tpu/`` (horovod_tpu.analysis.lockcheck)
@@ -17,9 +19,9 @@ ISSUE 11 added the SPMD divergence checker):
                       raw reads of choice knobs
                       (horovod_tpu.analysis.knobcheck)
 - ``metrics``       — METRIC_SPECS namespace lint
-                      (tools/check_metric_names.py)
+                      (horovod_tpu.analysis.metriccheck)
 - ``faults``        — FAULT_SPECS + failpoint call-site lint
-                      (tools/check_fault_names.py)
+                      (horovod_tpu.analysis.faultcheck)
 - ``trace_schema``  — trace-schema contract self-check: a synthetic
                       2-rank merged trace must pass
                       ``tools/trace_report.py --check``'s ``check_events``
@@ -29,6 +31,12 @@ ISSUE 11 added the SPMD divergence checker):
                       the commit barrier must reject mismatched
                       checksums / stale world_versions / partial
                       generations (horovod_tpu.checkpoint.manifest)
+- ``errflow``       — exception-propagation & resource-lifecycle
+                      analyzer: swallowed recovery errors on the
+                      elastic/dispatch/watchdog path, deadline-less raw
+                      transport calls, leak-on-raise resource
+                      lifecycles, silent error seams, failpoint drift
+                      (horovod_tpu.analysis.errflow)
 
 Usage (from the repo root)::
 
@@ -50,11 +58,11 @@ CI workflow (.github/workflows/lint.yml); the per-lint scripts remain
 as thin shims for single-lint runs.
 
 ``--changed`` is the dev-loop fast mode: it runs only the pure-AST
-lints (lockcheck, divcheck, knobs — the ones that don't import jax or
-run live subsystems), scanning the WHOLE tree so cross-file passes stay
-sound, but filtering lockcheck/divcheck findings to files changed vs
-``main`` (git diff --name-only + working-tree changes). The full scan
-stays the tier-1/CI default.
+lints (lockcheck, divcheck, knobs, errflow — the ones that don't import
+jax or run live subsystems), scanning the WHOLE tree so cross-file
+passes stay sound, but filtering lockcheck/divcheck/errflow findings to
+files changed vs ``main`` (git diff --name-only + working-tree
+changes). The full scan stays the tier-1/CI default.
 """
 
 from __future__ import annotations
@@ -119,22 +127,37 @@ def run_knobs() -> Tuple[List[str], dict]:
 
 
 def run_metrics() -> Tuple[List[str], dict]:
-    from check_metric_names import validate_specs
-    from horovod_tpu.metrics import METRIC_SPECS
-    return validate_specs(METRIC_SPECS), {"declared": len(METRIC_SPECS)}
+    from horovod_tpu.analysis import metriccheck
+    return metriccheck.run(PKG_ROOT)
 
 
 def run_faults() -> Tuple[List[str], dict]:
-    from check_fault_names import (scan_call_sites, validate_call_sites,
-                                   validate_specs)
-    from horovod_tpu.faults import FAULT_SPECS
-    errors = validate_specs(FAULT_SPECS)
-    sites = scan_call_sites(PKG_ROOT)
-    errors += validate_call_sites(FAULT_SPECS, sites)
-    if not sites:
-        errors.append("no failpoint call sites found under horovod_tpu/ "
-                      "— the scan is broken")
-    return errors, {"declared": len(FAULT_SPECS), "call_sites": len(sites)}
+    from horovod_tpu.analysis import faultcheck
+    return faultcheck.run(PKG_ROOT)
+
+
+def run_errflow(changed: Optional[set] = None) -> Tuple[List[str], dict]:
+    """Exception-propagation & resource-lifecycle analyzer (ISSUE 15).
+    The whole tree is always scanned — the recovery footprint and the
+    failpoint registry are cross-file — but ``--changed`` filters the
+    *findings* to the files being worked on."""
+    from horovod_tpu.analysis import errflow
+    rep = errflow.check_package(PKG_ROOT)
+    findings = rep.findings
+    if changed is not None:
+        findings = [f for f in findings if f.file in changed]
+    errors = [str(f) for f in findings]
+    stats = {"files": rep.files,
+             "defs": rep.defs,
+             "recovery_defs": rep.recovery_defs,
+             "handlers": rep.handlers,
+             "failpoints_declared": rep.failpoints_declared,
+             "failpoint_sites": rep.failpoint_sites,
+             "suppressions": [s.to_dict() for s in rep.suppressions],
+             "seams": [s.to_dict() for s in rep.seams]}
+    if changed is not None:
+        stats["changed_files"] = len(changed)
+    return errors, stats
 
 
 def run_trace_schema() -> Tuple[List[str], dict]:
@@ -248,14 +271,15 @@ CHECKS: Dict[str, Callable[[], Tuple[List[str], dict]]] = {
     "faults": run_faults,
     "trace_schema": run_trace_schema,
     "ckpt_manifest": run_ckpt_manifest,
+    "errflow": run_errflow,
 }
 
 # lints whose findings carry file:line and can be filtered to a changed
 # subset; also the pure-AST set --changed runs (knobs is pure-AST too
 # but registry-global: dead-knob detection needs the whole tree either
 # way, and it is cheap)
-FILE_SCOPED = ("lockcheck", "divcheck")
-CHANGED_MODE_LINTS = ("lockcheck", "divcheck", "knobs")
+FILE_SCOPED = ("lockcheck", "divcheck", "errflow")
+CHANGED_MODE_LINTS = ("lockcheck", "divcheck", "knobs", "errflow")
 
 
 def changed_files(base: str = "main") -> set:
@@ -326,6 +350,9 @@ def _print_text(report: dict):
         for a in stats.get("agreed_sites", []):
             print(f"       agreed[{a['what']}] {a['file']}:{a['line']} "
                   f"— {a['how']}")
+        for s in stats.get("seams", []):
+            print(f"       seam {s['file']}:{s['line']} {s['func']} "
+                  f"— {s['how']}")
     n_fail = sum(1 for r in report["checks"].values() if not r["ok"])
     total = len(report["checks"])
     print(f"{total - n_fail}/{total} lints passed")

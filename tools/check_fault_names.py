@@ -1,96 +1,37 @@
-"""Lint the failpoint namespace (the ``check_metric_names.py`` pattern):
+"""CLI shim for the failpoint-namespace lint.
 
-1. every entry in ``horovod_tpu.faults.FAULT_SPECS`` must match the fault
-   name regex and carry a non-empty help string;
-2. every ``failpoint("...")`` call site under ``horovod_tpu/`` must use a
-   name declared in ``FAULT_SPECS`` (``test.*`` names are reserved for
-   suites and must not appear in framework code).
-
-Thin shim: ``tools/check.py`` is the unified driver that runs this next
-to the lockcheck/knob/metric/trace-schema lints (one tier-1 test,
-tests/test_check.py). This entry point remains for single-lint runs:
-``python tools/check_fault_names.py``; exit code 0 means clean.
+The implementation lives in :mod:`horovod_tpu.analysis.faultcheck`
+(ISSUE 15 folded the scan into the analysis package; the call-site pass
+is AST-based now, so docstring examples no longer need a special case);
+``tools/check.py`` runs it next to the other lints. This entry point
+remains for single-lint runs: ``python tools/check_fault_names.py``;
+exit code 0 means clean.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import Dict, List, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-CALL_RE = re.compile(r"""failpoint\(\s*(['"])([^'"]+)\1\s*\)""")
-
-
-def validate_specs(specs: Dict[str, str]) -> List[str]:
-    """Return a list of error strings; empty means the table is clean."""
-    from horovod_tpu.faults import NAME_RE
-    errors = []
-    for name, help_str in sorted(specs.items()):
-        if not NAME_RE.match(name):
-            errors.append(f"{name}: does not match {NAME_RE.pattern}")
-        if name.startswith("test."):
-            errors.append(f"{name}: the test. prefix is reserved for "
-                          f"suite-local failpoints")
-        if not isinstance(help_str, str) or not help_str.strip():
-            errors.append(f"{name}: missing help string")
-    return errors
-
-
-def scan_call_sites(pkg_root: str) -> List[Tuple[str, int, str]]:
-    """Every ``failpoint("name")`` literal under ``pkg_root``:
-    (relpath, lineno, name)."""
-    sites = []
-    for dirpath, _dirnames, filenames in os.walk(pkg_root):
-        if "__pycache__" in dirpath:
-            continue
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            # faults.py itself only *defines* failpoint(); the matches in
-            # it are docstring examples, not call sites
-            if os.path.relpath(path, pkg_root) == "faults.py":
-                continue
-            with open(path) as f:
-                for lineno, line in enumerate(f, 1):
-                    for m in CALL_RE.finditer(line):
-                        sites.append((os.path.relpath(path, pkg_root),
-                                      lineno, m.group(2)))
-    return sites
-
-
-def validate_call_sites(specs: Dict[str, str],
-                        sites: List[Tuple[str, int, str]]) -> List[str]:
-    errors = []
-    for rel, lineno, name in sites:
-        if name not in specs:
-            errors.append(
-                f"{rel}:{lineno}: failpoint({name!r}) is not declared in "
-                f"horovod_tpu.faults.FAULT_SPECS")
-    return errors
+from horovod_tpu.analysis.faultcheck import (  # noqa: E402,F401
+    NAME_RE, scan_call_sites, validate_call_sites, validate_specs)
 
 
 def main() -> int:
-    from horovod_tpu.faults import FAULT_SPECS
-    pkg_root = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "horovod_tpu")
-    errors = validate_specs(FAULT_SPECS)
-    sites = scan_call_sites(pkg_root)
-    errors += validate_call_sites(FAULT_SPECS, sites)
-    placed = {name for _, _, name in sites}
-    unused = sorted(set(FAULT_SPECS) - placed)
+    from horovod_tpu.analysis import faultcheck
+    errors, stats = faultcheck.run()
     if errors:
         print(f"{len(errors)} failpoint declaration error(s):")
         for e in errors:
             print(f"  - {e}")
         return 1
-    print(f"{len(FAULT_SPECS)} declared failpoints OK; "
-          f"{len(sites)} call site(s) verified"
-          + (f"; declared but unplaced: {', '.join(unused)}" if unused
+    unplaced = stats.get("unplaced") or []
+    print(f"{stats['declared']} declared failpoints OK; "
+          f"{stats['call_sites']} call site(s) verified"
+          + (f"; declared but unplaced: {', '.join(unplaced)}" if unplaced
              else ""))
     return 0
 

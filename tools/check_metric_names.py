@@ -1,59 +1,32 @@
-"""Lint the metrics namespace: every metric the framework declares must
-match ``^hvd_tpu_[a-z0-9_]+$`` and carry a non-empty help string.
+"""CLI shim for the metric-namespace lint.
 
-Thin shim: ``tools/check.py`` is the unified driver that runs this next
-to the lockcheck/knob/fault/trace-schema lints (one tier-1 test,
-tests/test_check.py). This entry point remains for single-lint runs:
-``python tools/check_metric_names.py``; exit code 0 means clean. The
-registry factories enforce the same rules at runtime for undeclared
-names, but this check catches a bad declaration before anything ever
-instantiates it.
+The implementation lives in :mod:`horovod_tpu.analysis.metriccheck`
+(ISSUE 15 folded it into the analysis package); ``tools/check.py`` runs
+it next to the other lints. This entry point remains for single-lint
+runs: ``python tools/check_metric_names.py``; exit code 0 means clean.
 """
 
 from __future__ import annotations
 
 import os
 import sys
-from typing import Dict, List, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-VALID_TYPES = ("counter", "gauge", "histogram", "events")
-
-
-def validate_specs(specs: Dict[str, Tuple[str, str]]) -> List[str]:
-    """Return a list of error strings; empty means the table is clean."""
-    from horovod_tpu.metrics import NAME_RE
-    errors = []
-    for name, spec in sorted(specs.items()):
-        if not isinstance(spec, tuple) or len(spec) != 2:
-            errors.append(f"{name}: spec must be a (type, help) tuple")
-            continue
-        kind, help_str = spec
-        if not NAME_RE.match(name):
-            errors.append(
-                f"{name}: does not match {NAME_RE.pattern}")
-        if kind not in VALID_TYPES:
-            errors.append(f"{name}: unknown metric type {kind!r}")
-        if not isinstance(help_str, str) or not help_str.strip():
-            errors.append(f"{name}: missing help string")
-        if kind == "counter" and not name.endswith("_total"):
-            errors.append(
-                f"{name}: counters must end in _total "
-                f"(Prometheus naming convention)")
-    return errors
+from horovod_tpu.analysis.metriccheck import (  # noqa: E402,F401
+    VALID_TYPES, validate_specs)
 
 
 def main() -> int:
-    from horovod_tpu.metrics import METRIC_SPECS
-    errors = validate_specs(METRIC_SPECS)
+    from horovod_tpu.analysis import metriccheck
+    errors, stats = metriccheck.run()
     if errors:
         print(f"{len(errors)} metric declaration error(s):")
         for e in errors:
             print(f"  - {e}")
         return 1
-    print(f"{len(METRIC_SPECS)} declared metrics OK "
+    print(f"{stats['declared']} declared metrics OK "
           f"(^hvd_tpu_[a-z0-9_]+$, typed, documented)")
     return 0
 

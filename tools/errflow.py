@@ -1,0 +1,23 @@
+"""CLI shim for the exception-propagation & resource-lifecycle analyzer.
+
+The implementation lives in :mod:`horovod_tpu.analysis.errflow`;
+``tools/check.py`` runs it next to the other lints. This entry point
+exists for single-lint runs and for checking paths outside the package
+(the test fixtures do this)::
+
+    python tools/errflow.py                      # horovod_tpu/
+    python tools/errflow.py path/to/module.py --format=json
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from horovod_tpu.analysis.errflow import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

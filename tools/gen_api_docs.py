@@ -118,6 +118,9 @@ SECTIONS = [
     ("", "horovod_tpu.analysis.lockcheck", []),
     ("", "horovod_tpu.analysis.divcheck", []),
     ("", "horovod_tpu.analysis.knobcheck", []),
+    ("", "horovod_tpu.analysis.errflow", []),
+    ("", "horovod_tpu.analysis.faultcheck", []),
+    ("", "horovod_tpu.analysis.metriccheck", []),
     ("", "horovod_tpu.common.knobs", []),
 ]
 
