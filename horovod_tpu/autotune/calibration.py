@@ -368,3 +368,62 @@ def calibrate_engine(engine) -> Optional[MeasuredTopology]:
         _LOG.warning("link calibration failed (%s: %s); keeping the "
                      "nominal link tables", type(e).__name__, e)
         return None
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-schedule pricing (ISSUE 16): the measured α–β link model
+# applied to the stage-boundary point-to-point ring
+# ---------------------------------------------------------------------------
+
+def pipeline_hop_seconds(topology: Topology, act_bytes: int,
+                         dcn_edge: bool = False) -> float:
+    """Price one stage-boundary activation hop from the (measured when
+    available) link tables: α from the fitted flat-class launch latency
+    plus β·bytes over the edge's fabric. ``dcn_edge`` selects the DCN
+    bandwidth (see :func:`horovod_tpu.ops.collectives.ring_edge_is_dcn`
+    for the classification)."""
+    alpha = 0.0
+    fitted = getattr(topology, "fitted", None)
+    if callable(fitted):
+        fit = fitted("flat")
+        if fit is not None:
+            alpha = float(fit[0])
+    if not alpha:
+        alpha = float(getattr(topology, "launch_latency_us", 0.0)
+                      or 0.0) * 1e-6
+    gbps = topology.dcn_gbps if dcn_edge else topology.ici_gbps
+    beta_s = act_bytes / max(gbps * 1e9, 1.0)
+    return alpha + beta_s
+
+
+def price_pipeline_schedule(topology: Topology, schedule: str,
+                            n_stages: int, n_micro: int,
+                            n_virtual: int = 1, act_bytes: int = 0,
+                            cell_seconds: float = 1e-3,
+                            coded_edges=None,
+                            wire_scale: float = 1.0) -> float:
+    """Estimated wall time (s) of one pipeline step under a schedule: the
+    generated table's weighted tick profile priced at ``cell_seconds``
+    per F-unit, plus per-tick hop cost from the α–β model (the worst
+    edge dominates a synchronized tick; coded DCN edges pay
+    ``wire_scale`` of the bytes — the PR 13 codec ratio). This is the
+    costing behind ``HOROVOD_TPU_PIPELINE_SCHEDULE=auto``: pure
+    schedule-table math when no calibration ran, measured-link-aware
+    when it did."""
+    from ..parallel.pipeline import (build_schedule_tables,
+                                     predict_schedule_time)
+    mode = "zb" if schedule == "zb" else "interleaved"
+    vv = 1 if schedule == "1f1b" else max(1, n_virtual)
+    tb = build_schedule_tables(mode, n_stages, n_micro, vv)
+    work_units = predict_schedule_time(mode, n_stages, n_micro, vv)
+    # chunks are 1/v of a stage: normalize F-units to whole-stage seconds
+    chunk_seconds = cell_seconds / vv
+    hop = 0.0
+    if act_bytes:
+        edges = (tuple(coded_edges) if coded_edges
+                 else tuple([False] * n_stages))
+        per_edge = [pipeline_hop_seconds(
+            topology, int(act_bytes * (wire_scale if dcn else 1.0)),
+            dcn_edge=dcn) for dcn in edges]
+        hop = max(per_edge) if per_edge else 0.0
+    return work_units * chunk_seconds + tb.ticks * hop

@@ -190,6 +190,24 @@ HOROVOD_TPU_TUNE_PERSIST_DIR = "HOROVOD_TPU_TUNE_PERSIST_DIR"
 # when the user enabled a codec). Resolved once per engine; the
 # optimizer's compression= argument overrides per call.
 HOROVOD_TPU_COMPRESSION = "HOROVOD_TPU_COMPRESSION"
+# pipeline schedules (ISSUE 16, parallel/pipeline.py): SCHEDULE picks the
+# microbatch schedule — "1f1b" (default, the hand-scheduled baseline),
+# "interleaved" (virtual-stage round-robin chunks, Narayanan et al. 2021),
+# "zb" (zero-bubble B/W backward split, Qi et al. 2023), or "auto" (pick
+# schedule + microbatch count from the calibrated α–β model; an explicit
+# env pin wins). Also an autotune categorical ("pipeline_schedule" riding
+# the algo_sig replay re-arm edge). Degenerate combinations (m < stages,
+# interleaved without virtual chunks) demote to 1f1b with a one-time
+# WARNING. VIRTUAL_STAGES is the interleaved chunks-per-stage count v
+# (>= 2 activates interleaving; model depth must split into stages·v
+# chunks). MICROBATCHES overrides the microbatch count m (0 = caller
+# decides, or the α–β model under "auto"). BOUNDARY_CODEC applies the
+# PR 13 wire codecs to stage-boundary activation/cotangent hops that
+# cross DCN (ICI boundaries always stay raw; "none" default).
+HOROVOD_TPU_PIPELINE_SCHEDULE = "HOROVOD_TPU_PIPELINE_SCHEDULE"
+HOROVOD_TPU_PIPELINE_VIRTUAL_STAGES = "HOROVOD_TPU_PIPELINE_VIRTUAL_STAGES"
+HOROVOD_TPU_PIPELINE_MICROBATCHES = "HOROVOD_TPU_PIPELINE_MICROBATCHES"
+HOROVOD_TPU_PIPELINE_BOUNDARY_CODEC = "HOROVOD_TPU_PIPELINE_BOUNDARY_CODEC"
 # async sharded checkpointing (ISSUE 9, horovod_tpu/checkpoint/): setting
 # the directory enables the durable tier — TPUState commits snapshot
 # through the CheckpointManager and elastic recovery falls back to the
@@ -228,6 +246,7 @@ OVERLAP_PIPELINE_MODES = ("auto", "off", "interleave", "staged")
 DEFAULT_TREE_THRESHOLD_BYTES = 256 * 1024
 COLLECTIVE_ALGO_MODES = ("auto", "flat", "tree", "hierarchical")
 COMPRESSION_MODES = ("none", "bf16", "fp8", "int8")
+PIPELINE_SCHEDULE_MODES = ("1f1b", "interleaved", "zb", "auto")
 _XLA_LHS_FLAG = "--xla_tpu_enable_latency_hiding_scheduler=true"
 
 
@@ -372,6 +391,10 @@ class Config:
     # as a fitted quantity, the tree threshold is the user-facing dial
     hier_threshold_bytes: int = 0
     compression: str = "none"
+    pipeline_schedule: str = "1f1b"
+    pipeline_virtual_stages: int = 1
+    pipeline_microbatches: int = 0
+    pipeline_boundary_codec: str = "none"
     calibrate: bool = False
     tune_persist: bool = True
     tune_persist_dir: Optional[str] = None
@@ -405,6 +428,7 @@ class Config:
         "collective_algo": HOROVOD_TPU_COLLECTIVE_ALGO,
         "overlap_pipeline": HOROVOD_TPU_OVERLAP_PIPELINE,
         "compression": HOROVOD_TPU_COMPRESSION,
+        "pipeline_schedule": HOROVOD_TPU_PIPELINE_SCHEDULE,
         "single_launch": HOROVOD_TPU_SINGLE_LAUNCH,
         "step_replay": HOROVOD_TPU_STEP_REPLAY,
         "shard_optimizer": HOROVOD_TPU_SHARD_OPTIMIZER,
@@ -468,6 +492,16 @@ class Config:
                 DEFAULT_TREE_THRESHOLD_BYTES),
             compression=_get_choice(
                 HOROVOD_TPU_COMPRESSION, "none", COMPRESSION_MODES),
+            pipeline_schedule=_get_choice(
+                HOROVOD_TPU_PIPELINE_SCHEDULE, "1f1b",
+                PIPELINE_SCHEDULE_MODES),
+            pipeline_virtual_stages=_get_int(
+                HOROVOD_TPU_PIPELINE_VIRTUAL_STAGES, 1),
+            pipeline_microbatches=_get_int(
+                HOROVOD_TPU_PIPELINE_MICROBATCHES, 0),
+            pipeline_boundary_codec=_get_choice(
+                HOROVOD_TPU_PIPELINE_BOUNDARY_CODEC, "none",
+                COMPRESSION_MODES),
             calibrate=_get_bool(HOROVOD_TPU_CALIBRATE, False),
             tune_persist=_get_bool(HOROVOD_TPU_TUNE_PERSIST, True),
             tune_persist_dir=os.environ.get(HOROVOD_TPU_TUNE_PERSIST_DIR)

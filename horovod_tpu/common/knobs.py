@@ -129,6 +129,43 @@ KNOB_SPECS: Dict[str, dict] = {
                 "buckets are never quantized; fp8 demotes to int8 on jax "
                 "builds without a float8 dtype. Also an autotune "
                 "categorical (codec vs none) when enabled."},
+    # -- pipeline schedules (ISSUE 16) --------------------------------------
+    "HOROVOD_TPU_PIPELINE_SCHEDULE": {
+        "type": "choice", "default": "1f1b",
+        "choices": ("1f1b", "interleaved", "zb", "auto"),
+        "help": "Pipeline-parallel microbatch schedule "
+                "(parallel/pipeline.py): 1f1b is the hand-scheduled "
+                "baseline; interleaved runs round-robin virtual-stage "
+                "chunks (bubble q/(m+q), q=(p-1)/v); zb splits the "
+                "backward into B (activation-grad) and W (weight-grad) "
+                "passes with W deferred into the drain bubble; auto picks "
+                "schedule + microbatch count from the calibrated "
+                "alpha-beta model (env pin wins). All schedules are "
+                "bitwise-trajectory-equal to 1f1b at matched microbatch "
+                "count; degenerate combinations (m < stages, interleaved "
+                "with v < 2) demote to 1f1b with a one-time WARNING. "
+                "Also an autotune categorical riding the algo_sig replay "
+                "re-arm edge."},
+    "HOROVOD_TPU_PIPELINE_VIRTUAL_STAGES": {
+        "type": "int", "default": "1",
+        "help": "Virtual chunks per pipeline stage (interleaved "
+                "schedule): >= 2 activates interleaving, model depth "
+                "must split into stages*v chunks. Chunk c runs on stage "
+                "c % stages (round-robin placement)."},
+    "HOROVOD_TPU_PIPELINE_MICROBATCHES": {
+        "type": "int", "default": "0",
+        "help": "Microbatch count override for pipeline train steps "
+                "(0 = the caller's count, or the alpha-beta model's "
+                "pick under schedule=auto; must divide the global "
+                "batch)."},
+    "HOROVOD_TPU_PIPELINE_BOUNDARY_CODEC": {
+        "type": "choice", "default": "none",
+        "choices": ("none", "bf16", "fp8", "int8"),
+        "help": "Wire codec for stage-boundary activation/cotangent "
+                "hops that cross DCN (PR 13 codecs, stateless — no "
+                "error feedback on the non-reduction path). ICI "
+                "boundaries always stay raw: the partial-ppermute split "
+                "only moves quantized bytes on the coded edges."},
     "HOROVOD_TPU_LOCAL_SIZE": {
         "type": "int", "default": "derived",
         "help": "Topology override: ranks per fast-fabric island "

@@ -688,7 +688,12 @@ class Engine:
         return (cfg.collective_algo, cfg.tree_threshold_bytes,
                 cfg.hier_threshold_bytes,
                 cfg.hierarchical_allreduce, cfg.hierarchical_allgather,
-                cfg.compression)
+                cfg.compression,
+                # pipeline schedule knobs (ISSUE 16): a schedule or codec
+                # move changes the captured step program, so replay must
+                # re-warm on the same edge the collective knobs use
+                cfg.pipeline_schedule, cfg.pipeline_virtual_stages,
+                cfg.pipeline_boundary_codec)
 
     # -- link-aware gradient compression (ISSUE 13) ------------------------
 
@@ -1132,6 +1137,13 @@ class Engine:
             self.config.compression = (
                 v if isinstance(v, str)
                 else (self._codec_base if v else comp.CODEC_NONE))
+        # pipeline schedule (ISSUE 16): a string categorical like the
+        # above — a move lands in _algo_sig, so the armed pipeline step
+        # re-warms with the new schedule's table program
+        if pm.tunes("pipeline_schedule"):
+            v = pm.categorical_value("pipeline_schedule")
+            if isinstance(v, str):
+                self.config.pipeline_schedule = v
         # the tree threshold joined the numeric dims (ISSUE 14): the
         # calibrated derivation seeds it, the GP refines it; replay
         # re-arms through _algo_sig on every move

@@ -443,7 +443,18 @@ class StepReplay:
         and the armed program always resolve the same schedule (the
         fusion-threshold rebuild contract applied to ISSUE 10). One
         source of truth: the engine's signature, also used by the
-        grouped path's mid-call reuse guard."""
+        grouped path's mid-call reuse guard.
+
+        The pipeline schedule knobs (ISSUE 16) ride this same edge: a
+        pipeline train step keeps its whole microbatch loop inside one
+        jitted lax.scan (already a single launch — the O(1)-dispatch
+        property is the scan's, not replay's), and only its DP gradient
+        sync + optimizer update flow through the engine as replayable
+        dispatches. When the autotuner flips pipeline_schedule /
+        virtual_stages / boundary_codec, the STEP the model rebuilds is
+        a different program with the same dispatch signature — so the
+        sig move here forces the re-warm that keeps the armed launch and
+        the new schedule's table program in lockstep."""
         return self.engine._algo_sig()
 
     def _overlap_mode(self, nbytes: int, n_buckets: int,

@@ -224,6 +224,18 @@ class GlobalState:
             if cfg.compression != "none":
                 categorical += [("compression",
                                  ("none", cfg.compression))]
+            # pipeline schedule (ISSUE 16): offered only when the user
+            # did NOT pin the schedule via env (the pin wins over the
+            # tuner, matching the knob contract) — interleaved joins the
+            # choice set only when virtual chunks exist to interleave.
+            # Moves ride the algo_sig edge, so the armed pipeline step
+            # re-warms with the new schedule's tables.
+            if cfg.provenance.get("pipeline_schedule") != "env-forced":
+                sched_choices = ["1f1b", "zb"]
+                if cfg.pipeline_virtual_stages > 1:
+                    sched_choices.insert(1, "interleaved")
+                categorical += [("pipeline_schedule",
+                                 tuple(sched_choices))]
             # calibrated-model seeding (ISSUE 14): when the init probe
             # measured the fabric, the first explored candidates are the
             # measured model's predictions, not random points — built
@@ -253,6 +265,9 @@ class GlobalState:
                     "overlap_pipeline": cfg.overlap_pipeline,
                     "collective_algo": cfg.collective_algo,
                     "compression": cfg.compression,
+                    "pipeline_schedule": (
+                        cfg.pipeline_schedule
+                        if cfg.pipeline_schedule != "auto" else "1f1b"),
                 },
                 # the tree threshold joins the numeric dims, initialized
                 # at the calibrated derivation when the probe ran (the

@@ -420,8 +420,47 @@ def pp_param_specs(cfg: TransformerConfig):
     return {"embed": P(), "layers": layers, "ln_f": P()}
 
 
+def pp_layer_order(n_layers: int, n_stages: int, n_virtual: int,
+                   schedule: str = "interleaved"):
+    """Physical row order for the stacked [n_layers, ...] layer params.
+
+    The interleaved/zb table executors place global chunk ``c`` on stage
+    ``c % n_stages`` (round-robin — every chunk boundary is then the same
+    +1 ring hop), so stage ``s`` owns the NON-contiguous model chunks
+    ``{s, s+p, s+2p, ...}``. Sharding the stack ``P("pipe")`` hands each
+    stage a contiguous row block, so the rows must be pre-permuted: this
+    returns the permutation ``order`` such that ``stack[order]`` sharded
+    over pipe gives stage ``s`` its chunks in local-chunk order. For
+    contiguous placements (1f1b, or n_virtual == 1) it is the identity.
+    Gradients come back in the SAME permuted layout — consistent with the
+    permuted params, so the optimizer update needs no unpermute; apply
+    ``np.argsort(order)`` only when exporting back to model order."""
+    import numpy as np
+    from ..parallel.pipeline import pipeline_chunk_placement
+    if pipeline_chunk_placement(schedule, n_virtual) == "contiguous":
+        return np.arange(n_layers)
+    lpc = n_layers // (n_stages * n_virtual)
+    return np.concatenate([
+        np.arange((j * n_stages + s) * lpc, (j * n_stages + s + 1) * lpc)
+        for s in range(n_stages) for j in range(n_virtual)])
+
+
+def pp_permute_layers(params, order):
+    """Apply ``pp_layer_order`` to the stacked ``params["layers"]`` leaves
+    (host-side, once, before sharding). No-op for the identity order."""
+    import numpy as np
+    if bool(np.all(np.asarray(order) == np.arange(len(order)))):
+        return params
+    out = dict(params)
+    out["layers"] = jax.tree_util.tree_map(
+        lambda a: a[np.asarray(order)], params["layers"])
+    return out
+
+
 def make_pp_train_step(mesh: Mesh, cfg: TransformerConfig, optimizer,
-                       n_micro: int):
+                       n_micro: int, schedule: str = "1f1b",
+                       n_virtual: int = 1, boundary_codec=None,
+                       topology=None):
     """Pipeline-parallel flagship train step over a ``("pipe",)`` mesh —
     or a 2-D ``("data", "pipe")`` mesh for DP×PP composition — using the
     memory-bounded 1F1B schedule (parallel/pipeline.py): embedding on
@@ -436,16 +475,35 @@ def make_pp_train_step(mesh: Mesh, cfg: TransformerConfig, optimizer,
     where inputs/targets carry the GLOBAL batch (split over data).
 
     Beyond-reference (SURVEY §2.8: the reference has no PP); the schedule
-    keeps live activations O(n_stages) regardless of ``n_micro``."""
-    from ..parallel.pipeline import pipeline_train_1f1b, split_microbatches
+    keeps live activations O(n_stages) regardless of ``n_micro``.
+
+    ``schedule`` selects the pipeline schedule (ISSUE 16): ``1f1b``
+    (default), ``interleaved`` (virtual stages, needs ``n_virtual >= 2``),
+    ``zb`` (zero-bubble B/W split), or ``auto`` (α–β-model pick; see
+    ``resolve_pipeline_schedule``). All schedules are bitwise-identical to
+    1F1B at matched ``n_micro``. When the resolved placement is
+    round-robin (interleaved/zb with ``n_virtual > 1``) the caller must
+    pre-permute the stacked layer params with ``pp_permute_layers(params,
+    pp_layer_order(...))`` — grads return in the same layout.
+    ``boundary_codec`` is a ``(codec, coded_edges)`` pair (see
+    ``parallel.mesh.pipeline_boundary_edges``) enabling PR 13 wire codecs
+    on DCN-crossing stage boundaries."""
+    from ..parallel.pipeline import (pipeline_train_step,
+                                     resolve_pipeline_schedule,
+                                     split_microbatches)
     if cfg.use_moe:
         raise NotImplementedError("PP flagship: dense FFN only (compose "
                                   "MoE with dp/sp/tp via make_train_step)")
     d_size = mesh.shape.get(DATA_AXIS, 1)
     n_stages = mesh.shape[PIPE_AXIS]
-    if cfg.n_layers % n_stages:
+    # resolve ONCE at build time (divcheck: never on the dispatch path) so
+    # the parameter placement below matches what the executor will run
+    schedule, n_virtual = resolve_pipeline_schedule(
+        schedule, n_stages, n_micro, n_virtual, topology)
+    if cfg.n_layers % (n_stages * n_virtual):
         raise ValueError(f"n_layers {cfg.n_layers} must divide into "
-                         f"{n_stages} pipeline stages")
+                         f"{n_stages} pipeline stages x {n_virtual} "
+                         f"virtual chunks")
     if cfg.remat not in ("none", "block"):
         raise NotImplementedError(
             f"PP flagship supports remat='none'|'block', got {cfg.remat!r}")
@@ -482,12 +540,25 @@ def make_pp_train_step(mesh: Mesh, cfg: TransformerConfig, optimizer,
         # batch; microbatching happens per replica
         micro_in = split_microbatches(inputs, n_micro)
         micro_tgt = split_microbatches(targets, n_micro)
-        loss, gs, gf, gl = pipeline_train_1f1b(
-            stage_fn, params["layers"], micro_in, micro_tgt, loss_fn,
-            PIPE_AXIS, n_stages,
+        sp = params["layers"]
+        if n_virtual > 1:
+            # this stage's contiguous row block holds its n_virtual chunks
+            # back to back (pp_layer_order placed them); view as
+            # [v, layers_per_chunk, ...] for the table executor
+            sp = jax.tree_util.tree_map(
+                lambda a: a.reshape((n_virtual, a.shape[0] // n_virtual)
+                                    + a.shape[1:]), sp)
+        loss, gs, gf, gl = pipeline_train_step(
+            stage_fn, sp, micro_in, micro_tgt, loss_fn,
+            PIPE_AXIS, n_stages, schedule=schedule, n_virtual=n_virtual,
             first_fn=first_fn, first_params={"embed": params["embed"]},
             last_fn=last_fn, last_params={"embed": params["embed"],
-                                          "ln_f": params["ln_f"]})
+                                          "ln_f": params["ln_f"]},
+            boundary_codec=boundary_codec, topology=topology)
+        if n_virtual > 1:
+            gs = jax.tree_util.tree_map(
+                lambda a: a.reshape((a.shape[0] * a.shape[1],)
+                                    + a.shape[2:]), gs)
         grads = {"embed": gf["embed"] + gl["embed"],
                  "layers": gs, "ln_f": gl["ln_f"]}
         if d_size > 1:
@@ -513,6 +584,120 @@ def make_pp_train_step(mesh: Mesh, cfg: TransformerConfig, optimizer,
         return params, opt_state, loss
 
     return jax.jit(step, donate_argnums=(0, 1))
+
+
+def make_pp_engine_train_step(mesh: Mesh, cfg: TransformerConfig, opt,
+                              n_micro: int, schedule: Optional[str] = None,
+                              n_virtual: int = 0, boundary_codec=None,
+                              topology=None):
+    """PP × DP(ZeRO-1) composition riding the ENGINE (ISSUE 16 tentpole):
+    the pipeline microbatch loop runs inside ONE jitted shard_map over the
+    pipe mesh (a single XLA launch — the O(1)-dispatch half), and the
+    data-parallel gradient combine + optimizer update go through
+    ``opt.update_and_apply`` (a ``DistributedEagerOptimizer``), which
+    rides the full engine stack: fusion buckets, the overlap schedule,
+    PR 13 wire codecs, replay capture (steady state: one engine dispatch
+    per step), and — with ``sharded=True`` — the ZeRO-1 sharded update.
+
+    Contract differences vs ``make_pp_train_step``: ``mesh`` is the
+    pipe-only (sub)mesh of THIS data replica (``parallel.mesh.
+    pp_dp_sp_mesh`` carves it); params live REPLICATED at rest (the
+    engine's per-process view is the full model — ZeRO-1 shards the
+    optimizer state, not the weights), and the body all-gathers the
+    per-stage layer grads over pipe so every rank hands the engine the
+    full-model gradient: ranks of one replica then agree exactly, so the
+    engine's world average equals the data-axis mean. ``schedule=None``
+    defers to the ``HOROVOD_TPU_PIPELINE_*`` knobs (Config.from_env()).
+    Returns an EAGER ``(params, opt_state, inputs, targets) -> (params,
+    opt_state, loss)`` (the engine legs must stay outside jit so replay
+    can bracket them)."""
+    from ..common.env import Config
+    from ..parallel.pipeline import (pipeline_train_step,
+                                     resolve_pipeline_schedule,
+                                     split_microbatches)
+    if schedule is None:
+        ecfg = Config.from_env()
+        schedule = ecfg.pipeline_schedule
+        n_virtual = n_virtual or ecfg.pipeline_virtual_stages
+    n_virtual = max(1, int(n_virtual))
+    if cfg.use_moe:
+        raise NotImplementedError("PP flagship: dense FFN only")
+    n_stages = mesh.shape[PIPE_AXIS]
+    schedule, n_virtual = resolve_pipeline_schedule(
+        schedule, n_stages, n_micro, n_virtual, topology)
+    if cfg.n_layers % (n_stages * n_virtual):
+        raise ValueError(f"n_layers {cfg.n_layers} must divide into "
+                         f"{n_stages} pipeline stages x {n_virtual} "
+                         f"virtual chunks")
+    if cfg.remat not in ("none", "block"):
+        raise NotImplementedError(
+            f"PP flagship supports remat='none'|'block', got {cfg.remat!r}")
+    dt = cfg.dtype
+    layer_fn = functools.partial(_pp_layer, cfg=cfg, under_remat=True)
+    if cfg.remat == "block":
+        layer_fn = jax.checkpoint(layer_fn, prevent_cse=False)
+
+    def stage_fn(sp, x):
+        h, _ = lax.scan(lambda h, lp: (layer_fn(lp, h), None), x, sp)
+        return h
+
+    def first_fn(fp, micro_tok):
+        return fp["embed"][micro_tok].astype(dt)
+
+    def last_fn(lp, y):
+        h = _rmsnorm(y, lp["ln_f"])
+        return jnp.einsum("btd,vd->btv", h, lp["embed"].astype(dt))
+
+    rows = cfg.n_layers // n_stages
+
+    def body(params, inputs, targets):
+        micro_in = split_microbatches(inputs, n_micro)
+        micro_tgt = split_microbatches(targets, n_micro)
+        sp = params["layers"]
+        if n_virtual > 1:
+            sp = jax.tree_util.tree_map(
+                lambda a: a.reshape((n_virtual, rows // n_virtual)
+                                    + a.shape[1:]), sp)
+        loss, gs, gf, gl = pipeline_train_step(
+            stage_fn, sp, micro_in, micro_tgt, _lean_xent,
+            PIPE_AXIS, n_stages, schedule=schedule, n_virtual=n_virtual,
+            first_fn=first_fn, first_params={"embed": params["embed"]},
+            last_fn=last_fn, last_params={"embed": params["embed"],
+                                          "ln_f": params["ln_f"]},
+            boundary_codec=boundary_codec, topology=topology)
+        if n_virtual > 1:
+            gs = jax.tree_util.tree_map(
+                lambda a: a.reshape((rows,) + a.shape[2:]), gs)
+        # replicate the per-stage layer grads over pipe: the engine's DP
+        # reduction needs every rank of this replica to contribute the
+        # SAME full-model tensor (the world mean then equals the
+        # data-axis mean)
+        gs = jax.tree_util.tree_map(
+            lambda a: lax.all_gather(a, PIPE_AXIS, axis=0, tiled=True), gs)
+        return loss, {"embed": gf["embed"] + gl["embed"],
+                      "layers": gs, "ln_f": gl["ln_f"]}
+
+    from ..parallel.flash_attention import flash_available
+    specs = pp_param_specs(cfg)
+    grad_fn = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(specs, P(), P()),
+        out_specs=(P(), P()), check_vma=not flash_available()))
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+    # the engine-side update returns params in the ENGINE's placement (its
+    # per-process world view, a different device set than the pipe mesh);
+    # device_put places them back onto the pipe mesh for the next grad_fn
+    # call — local slices + replication, no host round-trip
+    def reshard(p):
+        return jax.tree_util.tree_map(jax.device_put, p, shardings)
+
+    def step(params, opt_state, inputs, targets):
+        loss, grads = grad_fn(params, inputs, targets)
+        params, opt_state = opt.update_and_apply(grads, opt_state, params)
+        return reshard(params), opt_state, loss
+
+    return step
 
 
 def shard_params(params, mesh: Mesh, cfg: TransformerConfig):

@@ -25,7 +25,7 @@ from __future__ import annotations
 import logging
 import math
 
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -230,6 +230,21 @@ def slice_groups(n: int, local_size: int):
     cross_groups = [[c * local_size + l for c in range(cross)]
                     for l in range(local_size)]
     return local_groups, cross_groups
+
+
+def ring_edge_is_dcn(n: int, local_size: int) -> Tuple[bool, ...]:
+    """Classify the n ring edges of the slice-major layout: edge i
+    connects rank i to rank (i+1) % n and is a DCN (cross-slice) edge iff
+    the two ranks live on different islands under the
+    :func:`slice_groups` rule. Single-island worlds have no DCN edges.
+    The pipeline boundary codec (ISSUE 16) uses this to decide which
+    stage-boundary hops get the wire codec — the same layout rule the
+    hierarchical ladder uses, for the same reason: coding an ICI edge
+    wastes precision for bandwidth that was never scarce."""
+    if local_size <= 1 or local_size >= n or n % local_size:
+        return tuple([False] * n)
+    return tuple((i // local_size) != (((i + 1) % n) // local_size)
+                 for i in range(n))
 
 
 def tree_groups(n: int) -> List[List[List[int]]]:

@@ -6,23 +6,31 @@ point-to-point primitive set was the transport for).
 - :mod:`.ring_attention` — sequence parallelism via ppermute K/V rotation.
 - :mod:`.ulysses` — sequence parallelism via head/sequence all-to-all.
 - :mod:`.moe` — expert parallelism (Switch top-1, all-to-all dispatch).
-- :mod:`.pipeline` — GPipe-style microbatched pipeline parallelism.
+- :mod:`.pipeline` — microbatched pipeline parallelism: 1F1B plus the
+  interleaved virtual-stage and zero-bubble (B/W-split) schedules behind
+  ``pipeline_train_step``'s schedule selector (ISSUE 16).
 """
 
-from .mesh import WORLD_AXIS, world_mesh
+from .mesh import (WORLD_AXIS, pipeline_boundary_edges, pp_dp_sp_mesh,
+                   world_mesh)
 from .ring_attention import (local_attention, ring_attention_p,
                              zigzag_indices)
 from .ulysses import ulysses_attention_p
 from .moe import MoEParams, init_moe, moe_layer_p
-from .pipeline import (merge_microbatches, pipeline_apply_p,
-                       pipeline_train_1f1b,
-                       split_microbatches)
+from .pipeline import (build_schedule_tables, merge_microbatches,
+                       pipeline_apply_p, pipeline_bubble_fraction,
+                       pipeline_chunk_placement, pipeline_train_1f1b,
+                       pipeline_train_step, predict_schedule_bubble,
+                       resolve_pipeline_schedule, split_microbatches)
 
 __all__ = [
-    "WORLD_AXIS", "world_mesh",
+    "WORLD_AXIS", "world_mesh", "pp_dp_sp_mesh", "pipeline_boundary_edges",
     "local_attention", "ring_attention_p", "zigzag_indices",
     "ulysses_attention_p",
     "MoEParams", "init_moe", "moe_layer_p",
-    "pipeline_apply_p", "pipeline_train_1f1b", "split_microbatches",
-    "merge_microbatches",
+    "pipeline_apply_p", "pipeline_train_1f1b", "pipeline_train_step",
+    "resolve_pipeline_schedule", "pipeline_chunk_placement",
+    "build_schedule_tables", "pipeline_bubble_fraction",
+    "predict_schedule_bubble",
+    "split_microbatches", "merge_microbatches",
 ]

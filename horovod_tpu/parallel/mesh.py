@@ -429,6 +429,48 @@ def multislice_mesh(dcn_axes: dict, ici_axes: dict,
     return Mesh(np.array(devs).reshape(shape), names)
 
 
+def pp_dp_sp_mesh(n_stages: int, data: int = -1, seq: int = 1,
+                  devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """PP × DP × SP composition mesh (ISSUE 16): ``pipe`` outermost —
+    stage boundaries are the fewest and most latency-tolerant transfers
+    (one point-to-point hop per tick), so pipeline parallelism is the
+    axis that should absorb DCN when the world spans slices. ``data``
+    (ZeRO-1 gradient sync; -1 absorbs remaining devices) sits in the
+    middle, and ``seq`` (ring-attention K/V rotation — the
+    bandwidth-hungriest ring) lands innermost on the fastest ICI ring.
+
+    The result is a standard ``training_mesh``: pipeline code runs
+    shard_map-manual over ``pipe`` per submesh row, DP gradient sync
+    rides the engine over ``data``, and SP attention rotates over
+    ``seq`` — see docs/parallelism.md for the composition rules."""
+    return training_mesh({"pipe": n_stages, "data": data, "seq": seq},
+                         devices)
+
+
+def pipeline_boundary_edges(topology: Topology, n_stages: int,
+                            stage_size: Optional[int] = None
+                            ) -> Tuple[bool, ...]:
+    """Which pipeline-ring boundaries cross DCN: entry i covers the
+    boundary between stage i and stage (i+1) % n_stages. A stage owns
+    ``stage_size`` consecutive ranks of the slice-major layout
+    (default: size // n_stages — the pp_dp_sp_mesh layout, where each
+    stage's DP×SP block is contiguous), and a boundary is DCN iff the
+    adjacent stages' blocks start on different islands. Feeds the
+    ``(codec, coded_edges)`` boundary-codec argument of
+    :func:`horovod_tpu.parallel.pipeline.pipeline_train_step` — only
+    DCN-crossing activation hops get the PR 13 wire codec."""
+    p = n_stages
+    g = stage_size if stage_size else max(1, topology.size // max(1, p))
+    ls = max(1, topology.local_size)
+    if ls <= 1 or ls >= topology.size:
+        return tuple([False] * p)
+
+    def island(s: int) -> int:
+        return ((s % p) * g) // ls
+
+    return tuple(island(i) != island(i + 1) for i in range(p))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

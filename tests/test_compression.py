@@ -565,14 +565,16 @@ class TestEngineResidualRegistry:
             assert eng.replay.replayed_steps >= 1
             armed = [e["armed"] for e in eng.replay._seen.values()
                      if e.get("armed")]
-            assert armed and armed[0].algo_sig[-1] == "none"
+            # the sig grew pipeline knobs in ISSUE 16 — compression sits at
+            # the slot _algo_sig documents, not the tail
+            assert armed and armed[0].algo_sig[5] == "none"
             eng.config.compression = "int8"
             eng.step_begin()
             hvd.grouped_allreduce(list(tensors), name="cc.9", op=hvd.Sum)
             eng.step_end()
             rearmed = [e["armed"] for e in eng.replay._seen.values()
                        if e.get("armed")]
-            assert rearmed and rearmed[0].algo_sig[-1] == "int8"
+            assert rearmed and rearmed[0].algo_sig[5] == "int8"
         finally:
             (eng.config.step_replay_warmup,
              eng.config.compression) = prev

@@ -451,13 +451,51 @@ def _fmt_us(us: float) -> str:
     return f"{us / 1e3:.2f} ms" if us >= 1e3 else f"{us:.0f} us"
 
 
+def _b36(n: int) -> str:
+    digits = "0123456789abcdefghijklmnopqrstuvwxyz"
+    return digits[n % 36]
+
+
+def schedule_timeline(schedule: str, n_stages: int, n_micro: int,
+                      n_virtual: int = 1) -> str:
+    """ASCII render of a pipeline schedule's static tick table (ISSUE 16):
+    one F/B(/W under zb) row per stage, one column per tick, base-36
+    microbatch index in active slots, '.' when the slot idles. The render
+    is the ground truth the executor scans — generated from the same
+    ``build_schedule_tables`` rows — so what prints here is literally what
+    dispatches."""
+    from horovod_tpu.parallel.pipeline import (build_schedule_tables,
+                                               pipeline_bubble_fraction,
+                                               resolve_pipeline_schedule)
+    sched, v = resolve_pipeline_schedule(schedule, n_stages, n_micro,
+                                         n_virtual)
+    tb = build_schedule_tables(sched, n_stages, n_micro, v)
+    lines = [f"schedule {sched}  p={n_stages} m={n_micro} v={v}  "
+             f"ticks={tb.ticks}  predicted bubble "
+             f"{pipeline_bubble_fraction(n_stages, n_micro, sched, v) * 100:.1f}%"]
+    slot_rows = [("F", "f_active", "f_m"), ("B", "b_active", "b_m")]
+    if tb.split_bw:
+        slot_rows.append(("W", "w_active", "w_m"))
+    for s in range(n_stages):
+        for i, (label, act, mrow) in enumerate(slot_rows):
+            head = f"stage {s}  " if i == 0 else " " * 9
+            cells = "".join(
+                _b36(int(tb.rows[mrow][t, s]))
+                if tb.rows[act][t, s] else "."
+                for t in range(tb.ticks))
+            lines.append(f"{head}{label} {cells}")
+    return "\n".join(lines)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
     p = argparse.ArgumentParser(
         description="Straggler / critical-path report over a merged "
-                    "cluster trace (GET /trace output)")
-    p.add_argument("trace", help="trace JSON file (object or array form; "
-                                 "truncated files are recovered)")
+                    "cluster trace (GET /trace output), or a static "
+                    "pipeline-schedule timeline (--schedule-timeline)")
+    p.add_argument("trace", nargs="?", default=None,
+                   help="trace JSON file (object or array form; "
+                        "truncated files are recovered)")
     p.add_argument("--check", action="store_true",
                    help="validate the event schema and correlation-id "
                         "invariants instead of reporting")
@@ -465,7 +503,25 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="stragglers to list (default 5)")
     p.add_argument("--json", action="store_true",
                    help="emit the report as JSON")
+    p.add_argument("--schedule-timeline", metavar="SCHED",
+                   help="render the static tick table for a pipeline "
+                        "schedule (1f1b|interleaved|zb|auto) instead of "
+                        "reading a trace")
+    p.add_argument("--stages", type=int, default=4,
+                   help="pipeline stages for --schedule-timeline")
+    p.add_argument("--micro", type=int, default=8,
+                   help="microbatches for --schedule-timeline")
+    p.add_argument("--virtual", type=int, default=1,
+                   help="virtual chunks per stage for --schedule-timeline")
     args = p.parse_args(argv)
+
+    if args.schedule_timeline:
+        print(schedule_timeline(args.schedule_timeline, args.stages,
+                                args.micro, args.virtual))
+        return 0
+    if args.trace is None:
+        p.error("a trace file is required unless --schedule-timeline "
+                "is given")
 
     from horovod_tpu.trace import load_trace_file
     events = load_trace_file(args.trace)
