@@ -718,7 +718,8 @@ def _size_label(nbytes: int) -> str:
     return f"{nbytes // 1024}KB"
 
 
-def bench_busbw(sizes_bytes=None, kinds=("allreduce", "allgather"),
+def bench_busbw(sizes_bytes=None,
+                kinds=("allreduce", "allgather", "alltoall"),
                 iters=8, codecs=("none", "int8")):
     """Bus-bandwidth message-size sweep vs the topology roofline
     (ISSUE 10 acceptance surface).
@@ -730,8 +731,11 @@ def bench_busbw(sizes_bytes=None, kinds=("allreduce", "allgather"),
     **bus bandwidth** is reported next to the nominal roofline
     (``Topology.roofline_busbw_gbps``). busbw follows the nccl-tests
     convention — algbw scaled by the algorithm-independent data-movement
-    factor (2(n-1)/n for allreduce, (n-1)/n for allgather) — so flat,
-    tree, and hierarchical lowerings land on one comparable axis.
+    factor (2(n-1)/n for allreduce, (n-1)/n for allgather and alltoall)
+    — so flat, tree, and hierarchical lowerings land on one comparable
+    axis. The alltoall sweep (ISSUE 17) selects per band with the
+    alltoall-specific knob + calibrated crossover, exactly the
+    engine's dispatch-bucket selection.
 
     Emitted fields: ``busbw_<kind>_<size>`` (GB/s),
     ``busbw_roofline_<kind>_<size>``, per-band spread, and
@@ -798,13 +802,34 @@ def bench_busbw(sizes_bytes=None, kinds=("allreduce", "allgather"),
         for size in sizes_bytes:
             label = _size_label(size)
             band = f"{kind}_{label}"
-            algo = C.choose_algorithm(
-                kind, size, topo, force=cfg.collective_algo,
-                tree_threshold_bytes=cfg.tree_threshold_bytes)
+            if kind == "alltoall":
+                # alltoall has its own knob and calibrated crossover —
+                # never the reduction ladder's (ISSUE 17)
+                algo = C.choose_algorithm(
+                    kind, size, topo, force=cfg.alltoall_algo,
+                    tree_threshold_bytes=cfg.tree_threshold_bytes,
+                    hier_threshold_bytes=(
+                        cfg.alltoall_hier_threshold_bytes))
+            else:
+                algo = C.choose_algorithm(
+                    kind, size, topo, force=cfg.collective_algo,
+                    tree_threshold_bytes=cfg.tree_threshold_bytes)
             selected[band] = algo
             elems = max(size // 4, n)  # float32
             rng = np.random.RandomState(0)
-            if kind == "allreduce":
+            if kind == "alltoall":
+                # even-split contract: dim0 divides the world size
+                elems = -(-elems // n) * n
+                fn = C.build_grouped_alltoall(
+                    mesh, "world", ((elems,),), [jnp.float32], [[0]],
+                    local_size=topo.local_size, algos=(algo,))
+                arg = jax.device_put(
+                    jnp.asarray(rng.rand(n, elems).astype(np.float32)),
+                    sh)
+                run = lambda fn=fn, arg=arg: fn(arg)[0]
+                factor = (n - 1) / n
+                payload = elems * 4
+            elif kind == "allreduce":
                 # stacked single-bucket grouped program: (n, elems) in,
                 # moved bytes factor 2(n-1)/n of the per-rank payload
                 fn = C.build_grouped_allreduce(
@@ -907,6 +932,140 @@ def bench_busbw(sizes_bytes=None, kinds=("allreduce", "allgather"),
     out["collective_algo_selected"] = selected
     out["busbw_escalations"] = total_escalations
     out["busbw_timing"] = f"median_of_3_spans_x{iters}_iters"
+    return out
+
+
+def bench_moe_ep(eng, steps=6):
+    """Expert-parallel MoE through the engine alltoall vs the dense FFN
+    at MATCHED ACTIVE PARAMS (ISSUE 17 acceptance): top-1 routing
+    activates exactly one d_ff expert per token, so the dense baseline
+    is the same config with ``use_moe=False`` — identical per-token
+    FLOPs, the difference is routing + the engine dispatch/combine
+    exchanges. Both sides are timed as dependent eager steps (the MoE
+    step's engine dispatch stream is real per-step cost and must be in
+    the number; labels make the convention explicit).
+
+    Also emits the two-slice DCN accounting artifact: the per-dispatch
+    payload of this config run through ``link_split`` on the reference
+    8x4 (two-slice) fixture — flat's whole-world exchange is DCN-paced
+    for the FULL payload, the hierarchical block transpose crosses DCN
+    with only (C-1)/C of it (factor C/(C-1) = 2x at two slices), and the
+    DCN-leg codec shrinks that leg further. Pure registry-rule
+    accounting (the dev rig's one-process world moves zero DCN bytes),
+    same convention as the transformer wire projection."""
+    import dataclasses
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from horovod_tpu.models.transformer import (
+        TransformerConfig, init_params, lean_lm_loss,
+        make_moe_ep_train_step, moe_ep_partition)
+    from horovod_tpu.ops import collectives as C
+
+    cfg = TransformerConfig(
+        vocab_size=1024, d_model=128, n_heads=4, n_layers=2, d_ff=512,
+        max_seq=128, dtype=jnp.float32, attention="flash", use_moe=True,
+        n_experts=8, moe_capacity_factor=2.0)
+    B, T = 4, cfg.max_seq
+    rank, size = eng.backend.rank(), eng.backend.size()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    shared, expert = moe_ep_partition(params, rank, size, cfg)
+    opt = optax.sgd(0.01)
+    moe_step = make_moe_ep_train_step(eng, cfg, opt)
+    ost = opt.init({"shared": shared, "expert": expert})
+    rng = np.random.RandomState(0)
+    tok = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)), jnp.int32)
+    tgt = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)), jnp.int32)
+
+    def run_moe(k, st):
+        sh, ex, o = st
+        loss = None
+        for _ in range(k):
+            sh, ex, o, loss = moe_step(sh, ex, o, tok, tgt)
+        jax.block_until_ready(loss)
+        return sh, ex, o
+
+    st = run_moe(2, (shared, expert, ost))   # warmup: arm replay streams
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        st = run_moe(steps, st)
+        samples.append((time.perf_counter() - t0) / steps)
+    samples.sort()
+    moe_dt = samples[1]
+    moe_spread = 100.0 * (samples[-1] - samples[0]) / max(moe_dt, 1e-12)
+
+    # dense baseline: same config minus routing — the matched-active-
+    # params comparison (one d_ff expert per token == the dense FFN)
+    dcfg = dataclasses.replace(cfg, use_moe=False)
+    dparams = init_params(jax.random.PRNGKey(0), dcfg)
+    dost = opt.init(dparams)
+
+    @jax.jit
+    def dense_step(p, o, xb, yb):
+        loss, g = jax.value_and_grad(lean_lm_loss)(p, xb, yb, dcfg)
+        u, o = opt.update(g, o, p)
+        return optax.apply_updates(p, u), o, loss
+
+    dst = (dparams, dost)
+    for _ in range(2):
+        dst = dense_step(dst[0], dst[1], tok, tgt)[:2]
+    dsamples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        p, o = dst
+        loss = None
+        for _ in range(steps):
+            p, o, loss = dense_step(p, o, tok, tgt)
+        jax.block_until_ready(loss)
+        dst = (p, o)
+        dsamples.append((time.perf_counter() - t0) / steps)
+    dsamples.sort()
+    dense_dt = dsamples[1]
+
+    tokens = B * T
+    out = {
+        "moe_ep_tokens_per_sec_per_chip": round(tokens / moe_dt / size, 1),
+        "moe_ep_dense_tokens_per_sec_per_chip": round(
+            tokens / dense_dt / size, 1),
+        "moe_ep_vs_dense": round(dense_dt / moe_dt, 3),
+        "moe_ep_spread_pct": round(moe_spread, 1),
+        "moe_ep_config": (f"d{cfg.d_model}xL{cfg.n_layers}x"
+                          f"ff{cfg.d_ff} E{cfg.n_experts} top1 "
+                          f"cap{cfg.moe_capacity_factor} B{B} T{T} "
+                          f"ep{size}"),
+        "moe_ep_timing": "dependent_eager_steps_median_of_3",
+    }
+    # two-slice DCN accounting: per-dispatch payload through link_split
+    # on the reference 8x4 fixture (size=8, local=4 -> C=2 slices)
+    import math as _math
+    fsize, flocal = 8, 4
+    capacity = _math.ceil(tokens * cfg.moe_capacity_factor /
+                          cfg.n_experts)
+    it = jnp.dtype(cfg.dtype).itemsize
+    disp_bytes = cfg.n_experts * capacity * cfg.d_model * it
+    flat = C.link_split(C.ALGO_FLAT, disp_bytes, flocal, kind="alltoall",
+                        itemsize=it, size=fsize)
+    hier = C.link_split(C.ALGO_HIERARCHICAL, disp_bytes, flocal,
+                        kind="alltoall", itemsize=it, size=fsize)
+    hier_bf16 = C.link_split(C.ALGO_HIERARCHICAL, disp_bytes, flocal,
+                             kind="alltoall", codec="bf16", itemsize=it,
+                             size=fsize)
+    # flat's single whole-world exchange is paced by the slowest fabric
+    # it crosses — on a two-slice fixture that is DCN for the full
+    # payload; the ladder pays DCN for only the cross-slice half
+    flat_dcn = flat.get("dcn", flat.get("flat", 0))
+    out.update({
+        "moe_dispatch_bytes_per_step": int(disp_bytes),
+        "moe_dispatch_dcn_bytes_flat_8x4": int(flat_dcn),
+        "moe_dispatch_dcn_bytes_hier_8x4": int(hier.get("dcn", 0)),
+        "moe_dispatch_dcn_bytes_hier_bf16_8x4": int(
+            hier_bf16.get("dcn", 0)),
+        "moe_dispatch_dcn_drop_factor": round(
+            flat_dcn / max(hier.get("dcn", 1), 1), 2),
+        "moe_dispatch_wire_projection": "hier8x4_registry_rules",
+    })
     return out
 
 
@@ -1542,6 +1701,15 @@ def main():
         busbw = bench_busbw()
     except Exception as e:
         busbw = {"busbw_error": f"{type(e).__name__}: {e}"}
+
+    # expert-parallel MoE through the engine alltoall vs the dense FFN
+    # at matched active params + the two-slice DCN dispatch accounting
+    # (ISSUE 17)
+    try:
+        moe = bench_moe_ep(eng)
+    except Exception as e:
+        moe = {"moe_ep_error": f"{type(e).__name__}: {e}"}
+    busbw.update(moe)
 
     # knob provenance (ISSUE 14): which knobs were env-forced / default /
     # calibrated / tuned, and the link table selection was reading

@@ -136,9 +136,18 @@ def derived_hier_threshold_bytes(flat: Tuple[float, float],
 
 def _busbw_factor(kind: str, n: int) -> float:
     """nccl-tests busbw convention (bench.bench_busbw)."""
-    if kind == "allgather":
+    if kind in ("allgather", "alltoall"):
         return (n - 1) / n
     return 2.0 * (n - 1) / n
+
+
+# alltoall probe classes (ISSUE 17): the dispatch payload's economics
+# share nothing with the reduction ladder's (O(n) whole-world chunks vs
+# O(n/slices) DCN blocks), so the alltoall band fits its OWN α–β rows
+# under these link_model keys and derives its own flat/hierarchical
+# crossover — never reusing the allreduce fits.
+A2A_CLASS_FLAT = "alltoall_flat"
+A2A_CLASS_HIER = "alltoall_hierarchical"
 
 
 def _probe_classes(topology: Topology, hier_ok: bool) -> List[str]:
@@ -184,8 +193,9 @@ def build_probes(engine, bands: Sequence[int] = PROBE_BANDS_BYTES
     topo = engine.topology
     mesh = engine.backend.group_mesh
     n = topo.size
+    hier_ok = engine._hierarchical_ok()
     probes: List[Tuple[str, int, object]] = []
-    for algo in _probe_classes(topo, engine._hierarchical_ok()):
+    for algo in _probe_classes(topo, hier_ok):
         for size in bands:
             elems = max(size // 4, n)
             fn = C.build_grouped_allreduce(
@@ -194,6 +204,24 @@ def build_probes(engine, bands: Sequence[int] = PROBE_BANDS_BYTES
             arr = engine.backend.to_global(
                 np.zeros((elems,), dtype=np.float32))
             probes.append((algo, size,
+                           lambda fn=fn, arr=arr: fn(arr)[0]))
+    # alltoall band (ISSUE 17): single-bucket grouped alltoalls built
+    # exactly the way the engine builds dispatch buckets, one class per
+    # fitted row. Classes/bands keep the fixed order every rank shares.
+    a2a_classes = [(A2A_CLASS_FLAT, C.ALGO_FLAT)]
+    if hier_ok:
+        a2a_classes.append((A2A_CLASS_HIER, C.ALGO_HIERARCHICAL))
+    for key, algo in a2a_classes:
+        for size in bands:
+            # dim0 must split evenly across the world (the grouped
+            # builder's even-split contract)
+            elems = -(-max(size // 4, n) // n) * n
+            fn = C.build_grouped_alltoall(
+                mesh, "world", ((elems,),), [jnp.float32], [[0]],
+                local_size=topo.local_size, algos=(algo,))
+            arr = engine.backend.to_global(
+                np.zeros((elems,), dtype=np.float32))
+            probes.append((key, size,
                            lambda fn=fn, arr=arr: fn(arr)[0]))
     return probes
 
@@ -315,6 +343,23 @@ def derived_thresholds(measured: MeasuredTopology) -> Tuple[int, int]:
     hier_thr = (derived_hier_threshold_bytes(flat, hier)
                 if flat is not None and hier is not None else 0)
     return tree_thr, hier_thr
+
+
+def derived_alltoall_threshold_bytes(measured: MeasuredTopology
+                                     ) -> Optional[int]:
+    """The measured flat/two-phase crossover for ALLTOALL dispatch
+    payloads, from the alltoall band's own fitted rows (ISSUE 17) —
+    same crossover algebra as the reduction ladder's
+    :func:`derived_hier_threshold_bytes`, fed the alltoall-specific
+    α–β pairs. None when the band was not probed (single-slice worlds
+    probe only the flat class, and an unprobed crossover must leave the
+    nominal "hierarchical whenever the topology factorizes" default
+    untouched rather than install a fake 0)."""
+    flat = measured.fitted(A2A_CLASS_FLAT)
+    hier = measured.fitted(A2A_CLASS_HIER)
+    if flat is None or hier is None:
+        return None
+    return derived_hier_threshold_bytes(flat, hier)
 
 
 def calibrate_engine(engine) -> Optional[MeasuredTopology]:

@@ -153,6 +153,35 @@ HOROVOD_ELASTIC_SLOT_FAILURE_LIMIT = "HOROVOD_ELASTIC_SLOT_FAILURE_LIMIT"
 # forcings demote to flat with a one-time WARNING, never a crash). Also an
 # autotune categorical ("collective_algo": env-resolved base vs flat).
 HOROVOD_TPU_COLLECTIVE_ALGO = "HOROVOD_TPU_COLLECTIVE_ALGO"
+# alltoall-specific algorithm forcing (ISSUE 17): the dispatch exchange
+# has its own knob because its auto crossover is calibrated separately
+# (an alltoall moves every byte once; a reduction moves ~2x) and because
+# a dense job may want hierarchical reductions while pinning dispatch
+# flat. "auto" (default) picks per (bytes, topology) with the calibrated
+# alltoall threshold; "flat"/"hierarchical" force ("tree" is not a valid
+# alltoall lowering and demotes with a one-time WARNING).
+HOROVOD_TPU_ALLTOALL_ALGO = "HOROVOD_TPU_ALLTOALL_ALGO"
+# wire codec for the hierarchical alltoall's cross-slice (DCN) block
+# transpose — the ISSUE 13 per-link placement extended to dispatched
+# tokens: ICI legs always stay full precision, and the codec here is
+# STATELESS (no error-feedback residual: dispatched tokens have no
+# step-over-step identity for a residual to telescope against). "none"
+# (default), "bf16", "fp8", "int8". Flat alltoalls ignore it.
+HOROVOD_TPU_ALLTOALL_CODEC = "HOROVOD_TPU_ALLTOALL_CODEC"
+# auto alltoall selection takes the flat single-phase lowering when the
+# dispatch payload is at most this many bytes (two extra launch legs
+# beat the DCN chunk saving only above the crossover). 0 (default) means
+# "hierarchical whenever the topology factorizes"; the calibration probe
+# overwrites the default with the measured crossover from the alltoall
+# band's own α–β rows (an explicit value here still wins).
+HOROVOD_TPU_ALLTOALL_HIER_THRESHOLD_BYTES = \
+    "HOROVOD_TPU_ALLTOALL_HIER_THRESHOLD_BYTES"
+# expert-parallel MoE capacity factor override (models/transformer.py
+# engine-alltoall training step): tokens-per-expert capacity = ceil(
+# tokens * factor / experts). 0 (default) defers to the model config's
+# value; > 0 overrides it fleet-wide (the dial the autotuner/operator
+# turns without touching model code).
+HOROVOD_TPU_MOE_CAPACITY_FACTOR = "HOROVOD_TPU_MOE_CAPACITY_FACTOR"
 # topology override (parallel/mesh.detect_topology): ranks per fast-fabric
 # island (ICI slice / host) when the device-attribute probe cannot see the
 # real fabric; takes precedence over launcher-derived local sizes
@@ -245,6 +274,7 @@ DEFAULT_OVERLAP_STAGE_BYTES = 8 * 1024 * 1024
 OVERLAP_PIPELINE_MODES = ("auto", "off", "interleave", "staged")
 DEFAULT_TREE_THRESHOLD_BYTES = 256 * 1024
 COLLECTIVE_ALGO_MODES = ("auto", "flat", "tree", "hierarchical")
+ALLTOALL_ALGO_MODES = ("auto", "flat", "hierarchical")
 COMPRESSION_MODES = ("none", "bf16", "fp8", "int8")
 PIPELINE_SCHEDULE_MODES = ("1f1b", "interleaved", "zb", "auto")
 _XLA_LHS_FLAG = "--xla_tpu_enable_latency_hiding_scheduler=true"
@@ -390,6 +420,14 @@ class Config:
     # crossover (ISSUE 14); deliberately not an env knob: it exists only
     # as a fitted quantity, the tree threshold is the user-facing dial
     hier_threshold_bytes: int = 0
+    alltoall_algo: str = "auto"
+    alltoall_codec: str = "none"
+    # the alltoall flat/hierarchical crossover — derived-only like
+    # hier_threshold_bytes (the calibration probe's alltoall band fits
+    # its own α–β rows; the exchange moves every byte exactly once, so
+    # the reduction crossover does not transfer)
+    alltoall_hier_threshold_bytes: int = 0
+    moe_capacity_factor: float = 0.0
     compression: str = "none"
     pipeline_schedule: str = "1f1b"
     pipeline_virtual_stages: int = 1
@@ -426,6 +464,10 @@ class Config:
         "cycle_time_ms": HOROVOD_CYCLE_TIME,
         "tree_threshold_bytes": HOROVOD_TPU_TREE_THRESHOLD_BYTES,
         "collective_algo": HOROVOD_TPU_COLLECTIVE_ALGO,
+        "alltoall_algo": HOROVOD_TPU_ALLTOALL_ALGO,
+        "alltoall_codec": HOROVOD_TPU_ALLTOALL_CODEC,
+        "alltoall_hier_threshold_bytes":
+            HOROVOD_TPU_ALLTOALL_HIER_THRESHOLD_BYTES,
         "overlap_pipeline": HOROVOD_TPU_OVERLAP_PIPELINE,
         "compression": HOROVOD_TPU_COMPRESSION,
         "pipeline_schedule": HOROVOD_TPU_PIPELINE_SCHEDULE,
@@ -490,6 +532,14 @@ class Config:
             tree_threshold_bytes=_get_int(
                 HOROVOD_TPU_TREE_THRESHOLD_BYTES,
                 DEFAULT_TREE_THRESHOLD_BYTES),
+            alltoall_algo=_get_choice(
+                HOROVOD_TPU_ALLTOALL_ALGO, "auto", ALLTOALL_ALGO_MODES),
+            alltoall_codec=_get_choice(
+                HOROVOD_TPU_ALLTOALL_CODEC, "none", COMPRESSION_MODES),
+            alltoall_hier_threshold_bytes=_get_int(
+                HOROVOD_TPU_ALLTOALL_HIER_THRESHOLD_BYTES, 0),
+            moe_capacity_factor=_get_float(
+                HOROVOD_TPU_MOE_CAPACITY_FACTOR, 0.0),
             compression=_get_choice(
                 HOROVOD_TPU_COMPRESSION, "none", COMPRESSION_MODES),
             pipeline_schedule=_get_choice(

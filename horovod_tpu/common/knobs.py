@@ -129,6 +129,44 @@ KNOB_SPECS: Dict[str, dict] = {
                 "buckets are never quantized; fp8 demotes to int8 on jax "
                 "builds without a float8 dtype. Also an autotune "
                 "categorical (codec vs none) when enabled."},
+    "HOROVOD_TPU_ALLTOALL_ALGO": {
+        "type": "choice", "default": "auto",
+        "choices": ("auto", "flat", "hierarchical"),
+        "help": "Alltoall lowering per dispatch bucket (ISSUE 17): auto "
+                "picks flat (one whole-world exchange) vs hierarchical "
+                "(intra-slice ICI exchange, then an inter-slice DCN block "
+                "transpose where each DCN link carries O(n/slices) blocks "
+                "instead of O(n)) per (bytes, topology); forced "
+                "hierarchical demotes to flat with a one-time WARNING "
+                "when the topology has no homogeneous factorization. "
+                "Selection uses the alltoall-specific calibrated "
+                "threshold, not the allreduce one."},
+    "HOROVOD_TPU_ALLTOALL_CODEC": {
+        "type": "choice", "default": "none",
+        "choices": ("none", "bf16", "fp8", "int8"),
+        "help": "Wire codec for the hierarchical alltoall's cross-slice "
+                "DCN leg only (ICI legs always stay full precision, and "
+                "the flat lowering never encodes). Stateless — dispatched "
+                "tokens have no step-over-step identity, so no error "
+                "feedback; fp8/int8 quantize per-sender with a shared "
+                "scale exchanged alongside the payload. Non-float "
+                "payloads are never quantized."},
+    "HOROVOD_TPU_ALLTOALL_HIER_THRESHOLD_BYTES": {
+        "type": "int", "default": "0 (hierarchical whenever possible)",
+        "help": "Auto alltoall selection keeps the flat single-phase "
+                "lowering when the dispatch payload is at most this "
+                "many bytes (the two-phase ladder's extra launch legs "
+                "only pay off above the crossover). The calibration "
+                "probe's alltoall band overwrites the 0 default with "
+                "the measured crossover; an explicit value here wins "
+                "over calibration."},
+    "HOROVOD_TPU_MOE_CAPACITY_FACTOR": {
+        "type": "float", "default": "0 (model config decides)",
+        "help": "Capacity-factor override for expert-parallel MoE "
+                "routing through the engine alltoall: per-expert "
+                "capacity = ceil(tokens * factor / n_experts). 0 defers "
+                "to the model's TransformerConfig value. Larger values "
+                "drop fewer tokens at the cost of more dispatch bytes."},
     # -- pipeline schedules (ISSUE 16) --------------------------------------
     "HOROVOD_TPU_PIPELINE_SCHEDULE": {
         "type": "choice", "default": "1f1b",

@@ -81,6 +81,7 @@ class Backend:
                         f"effect (backend already initialized on {got!r}); "
                         f"set it before any jax computation runs")
         self._removed = False
+        self._recoverable = False
         slot = None
         elastic = bool(os.environ.get(env_mod.HOROVOD_ELASTIC))
         if elastic:
@@ -122,6 +123,7 @@ class Backend:
                 # kill survivors hard instead of raising.)
                 try:
                     jax.config.update("jax_enable_recoverability", True)
+                    self._recoverable = True
                 except (AttributeError, ValueError) as e:
                     import logging
                     logging.getLogger("horovod_tpu").warning(
@@ -284,10 +286,20 @@ class Backend:
         Order is re-imposed through the launcher's KV, which outlives every
         world: non-zero ranks disconnect first and post a flag; rank 0
         collects the flags (bounded wait — a crashed peer never posts)
-        before tearing the service down."""
+        before tearing the service down.
+
+        The KV protocol applies ONLY when recoverability is actually on.
+        With the barrier present (static worlds), a non-zero rank's
+        ``jax.distributed.shutdown()`` blocks IN the barrier until rank 0
+        also enters it — so the flag would only ever be posted after rank 0
+        gave up waiting for it, turning every multi-process teardown into a
+        full HOROVOD_TPU_SHUTDOWN_ORDER_TIMEOUT stall. There the barrier
+        itself is the ordering guarantee (the service outlives every
+        client), and all ranks simply meet in it."""
         rdv_addr = os.environ.get(env_mod.HOROVOD_GLOO_RENDEZVOUS_ADDR)
         rdv_port = os.environ.get(env_mod.HOROVOD_GLOO_RENDEZVOUS_PORT)
-        if not rdv_addr or not rdv_port or self._size <= 1:
+        if not rdv_addr or not rdv_port or self._size <= 1 \
+                or not self._recoverable:
             jax.distributed.shutdown()
             return
         from ..runner.http_client import (put_data_into_kvstore,

@@ -269,7 +269,7 @@ class HandleManager:
 _KIND_CODES = {"allreduce": 1, "grouped_allreduce": 2, "allgather": 3,
                "broadcast": 4, "alltoall": 5, "reducescatter": 6,
                "barrier": 7, "adasum": 8, "grouped_broadcast": 9,
-               "sharded_step": 10}
+               "sharded_step": 10, "grouped_alltoall": 11}
 _DTYPE_CODES = {"float32": 1, "float64": 2, "float16": 3, "bfloat16": 4,
                 "int8": 5, "int16": 6, "int32": 7, "int64": 8,
                 "uint8": 9, "uint16": 10, "uint32": 11, "uint64": 12,
@@ -578,8 +578,9 @@ class Engine:
         values. The probe result was cross-rank agreed inside
         calibrate_engine, so the installed thresholds are identical
         everywhere (the selection-determinism invariant)."""
-        from ..autotune.calibration import calibrate_engine, \
-            derived_thresholds
+        from ..autotune.calibration import (calibrate_engine,
+                                            derived_alltoall_threshold_bytes,
+                                            derived_thresholds)
         measured = calibrate_engine(self)
         _reg = metrics_registry()
         if measured is None:
@@ -598,6 +599,19 @@ class Engine:
             prov["tree_threshold_bytes"] = "calibrated"
         self.config.hier_threshold_bytes = hier_thr
         prov["hier_threshold_bytes"] = "calibrated"
+        # alltoall's own crossover (ISSUE 17): installed only when the
+        # alltoall band actually probed both classes — an unprobed band
+        # keeps the nominal default, and an explicit env knob wins.
+        a2a_thr = derived_alltoall_threshold_bytes(measured)
+        if a2a_thr is not None:
+            if prov.get("alltoall_hier_threshold_bytes") == "env-forced":
+                logging.getLogger("horovod_tpu").info(
+                    "calibration derived alltoall crossover %d B but "
+                    "HOROVOD_TPU_ALLTOALL_HIER_THRESHOLD_BYTES is set; "
+                    "the explicit knob wins", a2a_thr)
+            else:
+                self.config.alltoall_hier_threshold_bytes = a2a_thr
+                prov["alltoall_hier_threshold_bytes"] = "calibrated"
         _reg.gauge("hvd_tpu_topology_calibrated").set(1.0)
         link_g = _reg.gauge("hvd_tpu_link_gbps")
         link_g.set(measured.ici_gbps, link="ici", source="measured")
@@ -645,6 +659,27 @@ class Engine:
         # exchange flat-view ranks skip — a deadlock). A heterogeneous
         # world uniformly agrees on "no hierarchy".
         hier_ok = self._hierarchical_ok()
+        if kind == "alltoall":
+            # alltoall has its OWN knob and its own calibrated crossover
+            # (ISSUE 17): the dispatch payload's flat-vs-two-phase
+            # economics (O(n) vs O(n/slices) DCN chunks) share nothing
+            # with the reduction ladder's, so neither the forced
+            # collective_algo nor hier_threshold_bytes apply. An unset
+            # (0) alltoall threshold means "hierarchical whenever the
+            # topology factorizes", same as the reduction default.
+            force = self.config.alltoall_algo
+            if force != "auto":
+                algo = C.validate_algorithm(kind, force, topo.size,
+                                            topo.local_size)
+            else:
+                algo = C.choose_algorithm(
+                    kind, nbytes, topo,
+                    tree_threshold_bytes=self.config.tree_threshold_bytes,
+                    hier_threshold_bytes=(
+                        self.config.alltoall_hier_threshold_bytes))
+            if algo == C.ALGO_HIERARCHICAL and not hier_ok:
+                return C.ALGO_FLAT
+            return algo
         force = self.config.collective_algo
         if force != "auto":
             algo = C.validate_algorithm(kind, force, topo.size,
@@ -689,6 +724,10 @@ class Engine:
                 cfg.hier_threshold_bytes,
                 cfg.hierarchical_allreduce, cfg.hierarchical_allgather,
                 cfg.compression,
+                # alltoall selection knobs (ISSUE 17): an algo/codec/
+                # threshold move must re-arm a2a replay segments
+                cfg.alltoall_algo, cfg.alltoall_codec,
+                cfg.alltoall_hier_threshold_bytes,
                 # pipeline schedule knobs (ISSUE 16): a schedule or codec
                 # move changes the captured step program, so replay must
                 # re-warm on the same edge the collective knobs use
@@ -727,6 +766,50 @@ class Engine:
             for c in out:
                 self._m_codec.inc(kind=kind, codec=c)
         return out
+
+    def _a2a_codecs(self, tensors, buckets, algos,
+                    count: bool = True) -> tuple:
+        """Per-bucket wire codec for an alltoall dispatch group (ISSUE
+        17): the HOROVOD_TPU_ALLTOALL_CODEC knob resolved per bucket
+        dtype — but ONLY for hierarchical buckets, because the codec
+        applies to the cross-slice DCN leg and a flat bucket has no
+        slow-link leg to encode (the ISSUE 13 placement rule). Stateless
+        (no error feedback): dispatched tokens have no step-over-step
+        identity for a residual to telescope against."""
+        base = self.config.alltoall_codec
+        if base == comp.CODEC_NONE or self.topology.size <= 1:
+            return (comp.CODEC_NONE,) * len(buckets)
+        out = tuple(
+            comp.resolve_codec(base, tensors[idxs[0]].dtype)
+            if algo == C.ALGO_HIERARCHICAL else comp.CODEC_NONE
+            for idxs, algo in zip(buckets, algos))
+        if count and self._m_enabled:
+            for c in out:
+                if c != comp.CODEC_NONE:
+                    self._m_codec.inc(kind="alltoall", codec=c)
+        return out
+
+    def _a2a_links(self, tensors, buckets, algos, codecs):
+        """Per-tensor link-byte split for alltoall dispatch traffic —
+        :meth:`_tensor_links` with the kind="alltoall" split, which
+        additionally needs the world size (C = size/local_size slices
+        set the (C-1)/C DCN share of the block transpose). Same
+        None-when-nobody-consumes contract."""
+        if self.topology.size <= 1 or not tensors:
+            return None
+        if not self._m_enabled and self.trace is None:
+            return None
+        local = self.topology.local_size
+        size = self.topology.size
+        links = [None] * len(tensors)
+        for idxs, algo, codec in zip(buckets, algos, codecs):
+            for i in idxs:
+                links[i] = C.link_split(
+                    algo, tensors[i].nbytes, local, kind="alltoall",
+                    codec=codec,
+                    itemsize=jnp.dtype(tensors[i].dtype).itemsize,
+                    size=size)
+        return links
 
     def _residual_key(self, tag: str, name: Optional[str], bucket: int,
                       algo: str, codec: str, elems: int,
@@ -798,7 +881,7 @@ class Engine:
             self._emit_replay("residual-invalidate", reason)
 
     def _m_codec_saved(self, kind: str, tensors, buckets, algos,
-                       codecs, links=None) -> None:
+                       codecs, links=None, size: int = 0) -> None:
         """Wire bytes the codecs removed, by link — the measurable face
         of the compression win next to the (already-encoded)
         hvd_tpu_wire_bytes_total series. Both series follow the
@@ -815,11 +898,13 @@ class Engine:
                 continue
             for i in idxs:
                 t = tensors[i]
-                orig = C.link_split(algo, t.nbytes, local, kind=kind)
+                orig = C.link_split(algo, t.nbytes, local, kind=kind,
+                                    size=size)
                 enc = (links[i] if links is not None and links[i]
                        else C.link_split(
                            algo, t.nbytes, local, kind=kind, codec=codec,
-                           itemsize=jnp.dtype(t.dtype).itemsize))
+                           itemsize=jnp.dtype(t.dtype).itemsize,
+                           size=size))
                 for link, b in orig.items():
                     saved = b - enc.get(link, 0)
                     if saved > 0:
@@ -1383,6 +1468,12 @@ class Engine:
                                    for i in range(size)], dtype=np.int32)
             self.alltoall(z, splits=splits,
                           _sub_hash=code >> 1).synchronize()
+        elif kind == "grouped_alltoall":
+            # even-splits contract: the advertised shapes already divide
+            # the world, so a zero group matches the active ranks' program
+            hs = self.grouped_alltoall([zero(r) for r in metas])
+            for h in hs:
+                h.synchronize()
         else:
             raise HorovodInternalError(
                 f"unknown substitute kind code {kind_code}")
@@ -2288,18 +2379,21 @@ class Engine:
         """Alltoall with optional uneven splits (operations.cc:951,
         mpi_operations.cc:380 MPI_Alltoallv semantics). Returns handle whose
         result is (received_tensor, recv_splits). ``_sub_hash``: see
-        :meth:`allgather` — the join-substitute replay path."""
+        :meth:`allgather` — the join-substitute replay path.
+
+        Topology-aware lowering (ISSUE 17): a rank whose splits are even
+        selects flat vs the hierarchical two-phase exchange per
+        (bytes, topology) through :meth:`_choose_algo` and books its wire
+        bytes under the ICI/DCN link split (stamped on the trace enqueue
+        event too). The hierarchical program actually dispatches only
+        when the EXCHANGED splits matrix is uniform — a collectively
+        agreed predicate, and uniformity implies every rank's payload
+        bytes (hence selection) were identical, so the demotion to flat
+        on ragged worlds can never diverge. Explicit uneven splits keep
+        the flat whole-world exchange; padding bytes are never counted
+        as wire bytes (accounting uses ``x.nbytes``, pre-padding)."""
         x = jnp.asarray(tensor)
         sub = self._consume_substitute()
-        self._m_account("alltoall", [x])
-        self._replay.observe("alltoall", sub, [x], name)
-        name = self._register(name, "alltoall", x.nbytes)
-        key_hash = _sub_hash if _sub_hash is not None else \
-            self._meta_hash(name)
-        self._join_sync("alltoall", [_join_meta_row(x, key_hash << 1)],
-                        skip=sub)
-        self._debug_check(name, "alltoall", [x], check_dim0=False,
-                          wildcard=sub)
         size = self.backend.size()
         mesh = self.backend.group_mesh
         if _sub_hash is not None:
@@ -2320,6 +2414,33 @@ class Engine:
             splits = np.asarray(splits, dtype=np.int32)
             if splits.sum() != int(x.shape[0]):
                 raise ValueError("splits must sum to tensor dim 0")
+        d0 = int(x.shape[0])
+        rowbytes = x.nbytes // d0 if d0 else 0
+        # Rank-local selection hint for accounting/trace; the dispatched
+        # lowering is re-agreed from the exchanged matrix below. In the
+        # steady even-splits case (the MoE dispatch shape) hint and
+        # dispatch always coincide.
+        hint = C.ALGO_FLAT
+        codec = comp.CODEC_NONE
+        links = None
+        if size > 1 and splits.size and bool((splits == splits[0]).all()):
+            hint = self._choose_algo("alltoall", x.nbytes)
+            if self._m_enabled:
+                self._m_algo.inc(kind="alltoall", algo=hint)
+            codec = self._a2a_codecs([x], [[0]], (hint,))[0]
+            links = self._a2a_links([x], [[0]], (hint,), (codec,))
+            self._m_codec_saved("alltoall", [x], [[0]], (hint,), (codec,),
+                                links, size=size)
+        self._m_account("alltoall", [x], links)
+        self._replay.observe("alltoall", sub, [x], name)
+        name = self._register(name, "alltoall", x.nbytes,
+                              link_bytes=links[0] if links else None)
+        key_hash = _sub_hash if _sub_hash is not None else \
+            self._meta_hash(name)
+        self._join_sync("alltoall", [_join_meta_row(x, key_hash << 1)],
+                        skip=sub)
+        self._debug_check(name, "alltoall", [x], check_dim0=False,
+                          wildcard=sub)
         # Exchange the full splits matrix: recv_splits[r] = splits_of_rank_r[me]
         # (controller's AlltoallGetRecvSplits, mpi_controller.cc:212).
         all_splits, deferred = self._exchange_sizes_cached(
@@ -2327,11 +2448,21 @@ class Engine:
         me = self.backend.rank()
         recv_splits = all_splits[:, me]
         max_chunk = int(all_splits.max()) if size > 1 else int(splits.max())
+        uniform = size > 1 and bool((all_splits == all_splits[0, 0]).all())
         if deferred is not None and deferred["stale_local"]:
             # splits changed after peers' cache went hot: dispatch with the
             # cached program shape (clamped garbage chunks) so nothing
             # hangs; every rank raises at extract
             splits = np.minimum(splits, max_chunk)
+            if uniform:
+                # this rank's live bytes changed but peers dispatch the
+                # cached-shape program — re-derive the selection from the
+                # AGREED matrix so the programs still match
+                hint = self._choose_algo(
+                    "alltoall", int(all_splits[0, 0]) * size * rowbytes)
+                codec = self._a2a_codecs([x], [[0]], (hint,),
+                                         count=False)[0]
+        algo = hint if uniform else C.ALGO_FLAT
         # Pad each send chunk to max_chunk, run equal alltoall, slice out.
         offs = np.concatenate([[0], np.cumsum(splits)[:-1]])
         chunks = [jax.lax.dynamic_slice_in_dim(x, int(offs[r]), int(splits[r]))
@@ -2339,7 +2470,15 @@ class Engine:
         padded = jnp.concatenate([
             jnp.pad(c, [(0, max_chunk - c.shape[0])] + [(0, 0)] * (x.ndim - 1))
             for c in chunks]) if size > 1 else x
-        fn = self._builder(("alltoall",), lambda: C.build_alltoall(mesh, self._axis()))
+        if algo == C.ALGO_HIERARCHICAL:
+            local = self.topology.local_size
+            fn = self._builder(
+                ("alltoall", C.ALGO_HIERARCHICAL, codec, local),
+                lambda: C.build_hierarchical_alltoall(
+                    mesh, self._axis(), local, codec))
+        else:
+            fn = self._builder(("alltoall",),
+                               lambda: C.build_alltoall(mesh, self._axis()))
         out = self._dispatch(name, lambda: fn(self.backend.to_global(padded)))
 
         def extract(gs):
@@ -2354,6 +2493,92 @@ class Engine:
         h = Handle(name, [out], extract, self, kind="alltoall")
         self._track(name, h)
         return h
+
+    def grouped_alltoall(self, tensors: Sequence,
+                         name: Optional[str] = None) -> List[Handle]:
+        """Fused even-split alltoall of many tensors (ISSUE 17): the
+        dispatch-traffic analog of :meth:`grouped_allreduce`, closing the
+        last fusion-bucketing gap in the op surface. Each tensor's dim 0
+        must divide the world size (the capacity-routed MoE dispatch
+        shape — fixed per step, identical on every rank, which is what
+        makes the call REPLAYABLE: a steady-state MoE-EP step collapses
+        to one fused launch). Per fusion bucket the member chunk
+        matrices concatenate into one exchange buffer, the bucket picks
+        flat vs hierarchical per (bytes, topology), and the
+        HOROVOD_TPU_ALLTOALL_CODEC codec encodes hierarchical buckets'
+        DCN leg only. Returns one handle per tensor whose result is the
+        received tensor (recv splits are even by contract)."""
+        tensors = [jnp.asarray(t) for t in tensors]
+        sub = self._consume_substitute()
+        size = self.backend.size()
+        for t in tensors:
+            if t.ndim == 0 or int(t.shape[0]) % size != 0:
+                raise ValueError(
+                    f"grouped_alltoall requires every tensor's dim 0 "
+                    f"divisible by size ({size}); got {tuple(t.shape)}. "
+                    f"Use alltoall(splits=...) for ragged dispatch.")
+        links = None
+        derived = None   # (threshold, sig, buckets, algos, codecs) reuse
+        if tensors:
+            if self.topology.size > 1:
+                thr0 = self.config.fusion_threshold_bytes
+                b0 = bucket_by_size(tensors, thr0)
+                a0 = self._bucket_algos("alltoall", tensors, b0)
+                c0 = self._a2a_codecs(tensors, b0, a0)
+                links = self._a2a_links(tensors, b0, a0, c0)
+                self._m_codec_saved("alltoall", tensors, b0, a0, c0,
+                                    links, size=size)
+                derived = (thr0, self._algo_sig(), b0, a0, c0)
+            self._m_account("grouped_alltoall", tensors, links)
+            r = self._replay.intercept("grouped_alltoall", tensors, 0,
+                                       1.0, 1.0, name, sub)
+            if r is not None:
+                return r
+        self._join_sync("grouped_alltoall",
+                        [_join_meta_row(t, 0) for t in tensors], skip=sub)
+        names = [self._register(None if name is None else f"{name}.{i}",
+                                "grouped_alltoall", t.nbytes,
+                                link_bytes=links[i] if links else None)
+                 for i, t in enumerate(tensors)]
+        self._debug_check(names[0] if names else "empty",
+                          "grouped_alltoall", tensors, wildcard=sub)
+        if not tensors:
+            return []
+        if derived is not None \
+                and derived[0] == self.config.fusion_threshold_bytes \
+                and derived[1] == self._algo_sig():
+            buckets, algos, codecs = derived[2], derived[3], derived[4]
+        else:
+            buckets = bucket_by_size(tensors,
+                                     self.config.fusion_threshold_bytes)
+            algos = self._bucket_algos("alltoall", tensors, buckets,
+                                       count=False)
+            codecs = self._a2a_codecs(tensors, buckets, algos,
+                                      count=False)
+        self._m_buckets_obs(tensors, buckets)
+        mesh = self.backend.group_mesh
+        local = self.topology.local_size
+        shapes = tuple(tuple(t.shape) for t in tensors)
+        dtypes = tuple(str(t.dtype) for t in tensors)
+        bkey = tuple(tuple(b) for b in buckets)
+        fn = self._builder(
+            ("grouped_alltoall", shapes, dtypes, bkey, local, algos,
+             codecs),
+            lambda: C.build_grouped_alltoall(
+                mesh, self._axis(), shapes, [t.dtype for t in tensors],
+                buckets, local_size=local, algos=algos, codecs=codecs))
+        outs = self._dispatch(
+            names,
+            lambda: fn(*[self.backend.to_global(t) for t in tensors]))
+        group = LaunchGroup(outs[-1])
+        handles = []
+        for i, nm in enumerate(names):
+            h = Handle(nm, [outs[i]],
+                       lambda gs: self.backend.from_global(gs[0]), self,
+                       group=group, kind="grouped_alltoall")
+            self._track(nm, h)
+            handles.append(h)
+        return handles
 
     def reducescatter(self, tensor, name: Optional[str] = None,
                       op: ReduceOp = ReduceOp.SUM) -> Handle:

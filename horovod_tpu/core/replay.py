@@ -56,6 +56,11 @@ _DIGITS = re.compile(r"\d+")
 _REDUCE_KINDS = ("allreduce", "grouped_allreduce")
 _BCAST_KINDS = ("broadcast", "grouped_broadcast")
 _SHARDED_KINDS = ("sharded_step",)
+# even-split alltoall dispatch groups (ISSUE 17): fixed shapes by
+# contract (capacity-routed MoE dispatch), so the exchange is replayable;
+# the uneven-splits eager alltoall stays on the observe() path — its
+# splits negotiation cannot be baked into a captured program
+_A2A_KINDS = ("grouped_alltoall",)
 _MAX_STREAMS = 16  # bound the per-signature table (LRU)
 
 
@@ -347,7 +352,8 @@ class StepReplay:
             return None
         sig = _make_sig(
             kind, tensors, code, pre, post, name,
-            replayable=kind in _REDUCE_KINDS + _BCAST_KINDS + _SHARDED_KINDS,
+            replayable=kind in (_REDUCE_KINDS + _BCAST_KINDS
+                                + _SHARDED_KINDS + _A2A_KINDS),
             extra=extra)
         self._recording.append(sig)
         if mode == "record":
@@ -392,8 +398,10 @@ class StepReplay:
     def observe(self, kind: str, sub: bool, tensors: Sequence = (),
                 name: Optional[str] = None):
         """Record (or fall back on) an engine call replay cannot service —
-        allgather/alltoall/reducescatter/barrier/adasum. A step containing
-        one never arms; encountering one while replaying is a divergence."""
+        allgather/uneven-alltoall/reducescatter/barrier/adasum. A step
+        containing one never arms; encountering one while replaying is a
+        divergence. (Even-split ``grouped_alltoall`` calls take
+        :meth:`intercept` instead — they replay, ISSUE 17.)"""
         mode = self._mode
         if mode in ("idle", "off"):
             return
@@ -487,6 +495,8 @@ class StepReplay:
                 cls = "sharded"
             elif sig.kind in _REDUCE_KINDS:
                 cls = "reduce"
+            elif sig.kind in _A2A_KINDS:
+                cls = "a2a"
             else:
                 cls = "bcast"
             key = (cls, sig.code, sig.pre, sig.post) + tuple(sig.extra)
@@ -504,7 +514,10 @@ class StepReplay:
             # true for a single reduce segment (per-bucket reduce
             # collectives) and for a single sharded segment (the sharded
             # advertisement raises on the joined rank, same as the normal
-            # sharded path). Anything else stays unarmed in Join worlds.
+            # sharded path). Anything else — including a2a segments, whose
+            # substitute would interleave its own join round mid-step —
+            # stays unarmed in Join worlds (MoE replay runs under
+            # HOROVOD_JOIN_DISABLE=1, docs/parallelism.md).
             if len(segs) != 1 or segs[0]["cls"] not in ("reduce", "sharded"):
                 return None
             op_code = segs[0]["key"][1]
@@ -544,8 +557,8 @@ class StepReplay:
         def _note_links(algo: str, b: int, kind: str = "allreduce",
                         codec: str = _comp.CODEC_NONE, itemsize: int = 4):
             for link, v in _C.link_split(algo, b, topo_local, kind=kind,
-                                         codec=codec,
-                                         itemsize=itemsize).items():
+                                         codec=codec, itemsize=itemsize,
+                                         size=world).items():
                 link_total[link] = link_total.get(link, 0) + v
 
         for seg in segs:
@@ -638,6 +651,24 @@ class StepReplay:
                     else:
                         res_specs.append(None)
                 seg_res.append(tuple(res_specs))
+                topo_field = (topo_local, algos, codecs)
+            elif cls == "a2a":
+                # per-bucket flat/hierarchical selection + the stateless
+                # DCN-leg codec (ISSUE 17), resolved through the same
+                # engine helpers the eager warmup path used — armed and
+                # eager programs agree, a knob move re-arms via algo_sig,
+                # and no residual rows ever (the codec is one-shot)
+                algos = tuple(
+                    eng._choose_algo("alltoall",
+                                     sum(proxies[i].nbytes for i in b))
+                    for b in buckets)
+                codecs = eng._a2a_codecs(proxies, buckets, algos,
+                                         count=False)
+                for idxs, algo, c in zip(buckets, algos, codecs):
+                    _note_links(algo, sum(proxies[i].nbytes for i in idxs),
+                                kind="alltoall", codec=c,
+                                itemsize=proxies[idxs[0]].dtype.itemsize)
+                seg_res.append((None,) * len(buckets))
                 topo_field = (topo_local, algos, codecs)
             else:
                 for b in buckets:

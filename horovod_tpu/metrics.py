@@ -156,6 +156,17 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
     "hvd_tpu_ckpt_last_step": (
         "gauge", "Step of the last locally-written checkpoint "
                  "generation"),
+    # models/transformer.py (ISSUE 17 expert-parallel MoE)
+    "hvd_tpu_moe_expert_tokens_total": (
+        "counter", "Tokens routed to each expert by the MoE-EP engine "
+                   "train step's capacity router, by expert index "
+                   "(pre-capacity counts — dropped-overflow tokens still "
+                   "count toward the expert they chose)"),
+    "hvd_tpu_moe_dispatch_skew": (
+        "gauge", "Last MoE-EP routing decision's expert load imbalance: "
+                 "max per-expert token count / mean (1.0 = perfectly "
+                 "balanced), by layer — the per-expert face of the PR 5 "
+                 "arrival-skew machinery"),
     # stall_inspector.py
     "hvd_tpu_stall_publish_failures_total": (
         "counter", "Stall-inspector KV liveness publishes that failed"),

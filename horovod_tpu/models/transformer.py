@@ -23,6 +23,7 @@ import dataclasses
 import functools
 from typing import Any, Optional, Tuple
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 import optax
@@ -387,11 +388,16 @@ PIPE_AXIS = "pipe"
 
 
 def _pp_layer(lp, h, cfg: TransformerConfig, under_remat: bool = False):
-    """One dense transformer layer on a local activation block — the same
+    """One transformer layer on a local activation block — the same
     math as ``_forward``'s layer closure restricted to its PP-relevant
-    case (no seq/tensor collectives, dense FFN); kept in lockstep with it
+    case (no seq/tensor collectives); kept in lockstep with it
     so the pipelined flagship reproduces the monolithic numerics,
-    including the under-remat splash→flash VMEM degrade."""
+    including the under-remat splash→flash VMEM degrade. With
+    ``cfg.use_moe`` the FFN is the capacity-routed MoE with every expert
+    resident on the stage (EP degree 1 inside the pipeline body — the
+    cross-rank EP transport is the ENGINE's alltoall, which cannot run
+    inside this jitted program; the load-balance aux term is omitted
+    from the pipeline objective, see docs/parallelism.md)."""
     dt = cfg.dtype
     flash = cfg.attention == "flash"
     x = _rmsnorm(h, lp["ln1"])
@@ -407,6 +413,12 @@ def _pp_layer(lp, h, cfg: TransformerConfig, under_remat: bool = False):
     h = h + jnp.einsum("bhtk,hkd->btd" if flash else "bthk,hkd->btd",
                        att, lp["wo"].astype(dt))
     x = _rmsnorm(h, lp["ln2"])
+    if cfg.use_moe:
+        b, t, d = x.shape
+        mp = MoEParams(lp["router"], lp["w1"], lp["w2"])
+        y2d, _ = moe_layer_p(x.reshape(b * t, d), mp, None, 1,
+                             capacity_factor=cfg.moe_capacity_factor)
+        return h + y2d.reshape(b, t, d)
     u = jax.nn.gelu(jnp.einsum("btd,df->btf", x, lp["w1"].astype(dt)))
     return h + jnp.einsum("btf,fd->btd", u, lp["w2"].astype(dt))
 
@@ -414,9 +426,13 @@ def _pp_layer(lp, h, cfg: TransformerConfig, under_remat: bool = False):
 def pp_param_specs(cfg: TransformerConfig):
     """Param shardings for the pipeline-parallel flagship: the stacked
     [n_layers, ...] layer params split over the pipe axis; the (tied)
-    embedding and final norm replicated on every stage."""
-    layers = {k: P(PIPE_AXIS) for k in
-              ("ln1", "wq", "wk", "wv", "wo", "ln2", "w1", "w2")}
+    embedding and final norm replicated on every stage. MoE layers add
+    the router to the per-stage split (every expert is resident on its
+    stage — EP degree 1 inside the pipeline body)."""
+    keys = ("ln1", "wq", "wk", "wv", "wo", "ln2", "w1", "w2")
+    if cfg.use_moe:
+        keys = keys + ("router",)
+    layers = {k: P(PIPE_AXIS) for k in keys}
     return {"embed": P(), "layers": layers, "ln_f": P()}
 
 
@@ -620,8 +636,6 @@ def make_pp_engine_train_step(mesh: Mesh, cfg: TransformerConfig, opt,
         schedule = ecfg.pipeline_schedule
         n_virtual = n_virtual or ecfg.pipeline_virtual_stages
     n_virtual = max(1, int(n_virtual))
-    if cfg.use_moe:
-        raise NotImplementedError("PP flagship: dense FFN only")
     n_stages = mesh.shape[PIPE_AXIS]
     schedule, n_virtual = resolve_pipeline_schedule(
         schedule, n_stages, n_micro, n_virtual, topology)
@@ -696,6 +710,251 @@ def make_pp_engine_train_step(mesh: Mesh, cfg: TransformerConfig, opt,
         loss, grads = grad_fn(params, inputs, targets)
         params, opt_state = opt.update_and_apply(grads, opt_state, params)
         return reshard(params), opt_state, loss
+
+    return step
+
+
+def moe_ep_partition(params, rank: int, size: int, cfg: TransformerConfig):
+    """Split a full ``init_params(use_moe=True)`` pytree into the MoE-EP
+    engine step's placement: ``(shared, expert)`` where ``shared`` (embed,
+    attention, norms, router) is the full replicated copy every rank holds
+    and ``expert`` is THIS rank's slice of the expert stacks —
+    ``w1 [L, E/size, D, F]`` / ``w2 [L, E/size, F, D]`` for experts
+    ``[rank·E/size, (rank+1)·E/size)``. Host-side, once, before training."""
+    if cfg.n_experts % max(size, 1):
+        raise ValueError(f"n_experts {cfg.n_experts} must divide over "
+                         f"{size} expert-parallel ranks")
+    el = cfg.n_experts // max(size, 1)
+    layers = dict(params["layers"])
+    expert = {"w1": layers.pop("w1")[:, rank * el:(rank + 1) * el],
+              "w2": layers.pop("w2")[:, rank * el:(rank + 1) * el]}
+    shared = {"embed": params["embed"], "layers": layers,
+              "ln_f": params["ln_f"]}
+    return shared, expert
+
+
+def make_moe_ep_train_step(engine, cfg: TransformerConfig, optimizer):
+    """Expert-parallel MoE train step riding the ENGINE alltoall (ISSUE 17
+    tentpole): experts sharded over the engine world (one device per
+    process — the DP axis), capacity-based top-1 routing in lockstep with
+    :func:`~horovod_tpu.parallel.moe.moe_layer_p`'s math, but the dispatch
+    and combine exchanges go through ``engine.grouped_alltoall`` — so they
+    ride the full engine stack: per-(bytes, topology) flat-vs-hierarchical
+    selection, link-split wire accounting, the DCN-leg codec, replay
+    capture, and Join metadata.
+
+    Structure: the per-rank compute (embedding, attention, routing/pack,
+    expert FFN, combine, loss head) runs as jitted segments chained with
+    ``jax.vjp``; every cross-rank exchange is an eager engine call
+    bracketed in its OWN ``step_begin``/``step_end`` pair (the
+    ``DistributedEagerOptimizer`` reduction-phase convention), so each
+    steady-state exchange arms and replays as exactly ONE fused engine
+    dispatch. Per train step with L layers that is 4·L alltoall rounds
+    (forward dispatch+combine, backward combine+dispatch — the uniform
+    block exchange is its own transpose) plus one grouped_allreduce round
+    averaging the shared-parameter grads and the loss. Expert-weight grads
+    stay LOCAL: each rank's experts saw every rank's tokens for them, so
+    the local gradient is already the complete global gradient.
+
+    Capacity: per-rank per-expert ``ceil(T·factor/E)`` where ``factor`` is
+    ``engine.config.moe_capacity_factor`` when set (>0), else
+    ``cfg.moe_capacity_factor``. Routing statistics feed
+    ``hvd_tpu_moe_expert_tokens_total`` (by expert) and the per-layer
+    ``hvd_tpu_moe_dispatch_skew`` gauge (max/mean per-expert load — the
+    PR 5 skew machinery's per-expert face).
+
+    Returns an EAGER ``step(shared, expert, opt_state, tokens, targets) ->
+    (shared, expert, opt_state, loss)`` over the placement
+    :func:`moe_ep_partition` produces; ``opt_state`` is
+    ``optimizer.init({"shared": shared, "expert": expert})``."""
+    import math as _math
+    from ..metrics import registry as _registry
+    from ..common.reduce_ops import ReduceOp
+
+    n = engine.backend.size()
+    E = cfg.n_experts
+    if E % max(n, 1):
+        raise ValueError(f"n_experts {E} must divide over {n} "
+                         f"expert-parallel ranks")
+    el = E // max(n, 1)
+    capf = engine.config.moe_capacity_factor or cfg.moe_capacity_factor
+    dt = cfg.dtype
+    L = cfg.n_layers
+    aux_w = cfg.moe_aux_weight
+    flash = cfg.attention == "flash"
+    reg = _registry()
+    m_tokens = reg.counter("hvd_tpu_moe_expert_tokens_total")
+    m_skew = reg.gauge("hvd_tpu_moe_dispatch_skew")
+
+    @jax.jit
+    def seg_embed(shared, tokens):
+        return shared["embed"][tokens].astype(dt)
+
+    def _attn(lp, x):
+        qkv_eq = "btd,dhk->bhtk" if flash else "btd,dhk->bthk"
+        q = jnp.einsum(qkv_eq, x, lp["wq"].astype(dt))
+        k = jnp.einsum(qkv_eq, x, lp["wk"].astype(dt))
+        v = jnp.einsum(qkv_eq, x, lp["wv"].astype(dt))
+        if flash:
+            att = flash_attention_local(q, k, v, causal=True, layout="bhtk")
+        else:
+            att = local_attention(q, k, v, causal=True)
+        return jnp.einsum("bhtk,hkd->btd" if flash else "bthk,hkd->btd",
+                          att, lp["wo"].astype(dt))
+
+    def _route_pack(shared, h, capacity, i):
+        """Attention + capacity routing + dispatch-buffer pack for layer
+        ``i``. Differentiated outputs: (dispatch buffer [E·C, D] in
+        engine-exchange rank-major layout, aux loss, gate·keep [T],
+        post-attention residual). Aux outputs (non-diff): expert/slot
+        indices for the combine and the per-expert routing counts."""
+        lp = {k: v[i] for k, v in shared["layers"].items()}
+        x = _rmsnorm(h, lp["ln1"])
+        h = h + _attn(lp, x)
+        x = _rmsnorm(h, lp["ln2"])
+        b, t, d = x.shape
+        tok = x.reshape(b * t, d)
+        logits = (tok @ lp["router"].astype(tok.dtype)).astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)
+        gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+        onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)
+        counts = jnp.sum(onehot, axis=0)
+        aux = E * jnp.sum((counts / (b * t)) *
+                          (jnp.sum(probs, axis=0) / (b * t)))
+        pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot,
+                      axis=-1).astype(jnp.int32) - 1
+        keep = jnp.logical_and(pos < capacity, pos >= 0)
+        slot = jnp.where(keep, pos, capacity - 1)
+        gatek = gate * keep.astype(jnp.float32)
+        disp = jnp.zeros((E, capacity, d), tok.dtype)
+        disp = disp.at[expert, slot].add(
+            tok * keep[:, None].astype(tok.dtype))
+        # [E, C, D] is already the exchange layout: dim0 chunk k (global
+        # experts [k·el, (k+1)·el)) goes to the rank that owns them
+        return (disp.reshape(E * capacity, d), aux, gatek, h), \
+            (expert, slot, counts)
+
+    def _expert_ffn(exp, r_flat, capacity, i):
+        """Local-expert FFN on the received tokens; returns the combine
+        buffer back in exchange layout. relu matches moe_layer_p so the
+        two transports are numerically interchangeable."""
+        d = r_flat.shape[-1]
+        e_in = r_flat.reshape(n, el, capacity, d).transpose(1, 0, 2, 3) \
+            .reshape(el, n * capacity, d)
+        hfe = jax.nn.relu(jnp.einsum("ecd,edf->ecf", e_in,
+                                     exp["w1"][i].astype(r_flat.dtype)))
+        y = jnp.einsum("ecf,efd->ecd", hfe,
+                       exp["w2"][i].astype(r_flat.dtype))
+        return y.reshape(el, n, capacity, d).transpose(1, 0, 2, 3) \
+            .reshape(n * el * capacity, d)
+
+    def _combine(h, c_flat, gatek, expert, slot, capacity):
+        b, t, d = h.shape
+        comb = c_flat.reshape(E, capacity, d)
+        out = comb[expert, slot] * gatek.astype(comb.dtype)[:, None]
+        return h + out.reshape(b, t, d)
+
+    @jax.jit
+    def seg_loss(shared, h, targets):
+        hf = _rmsnorm(h, shared["ln_f"])
+        logits = jnp.einsum("btd,vd->btv", hf, shared["embed"].astype(dt))
+        return _lean_xent(logits, targets)
+
+    seg_route = [jax.jit(functools.partial(_route_pack, i=i), static_argnums=(2,))
+                 for i in range(L)]
+    seg_ffn = [jax.jit(functools.partial(_expert_ffn, i=i), static_argnums=(2,))
+               for i in range(L)]
+    seg_comb = jax.jit(_combine, static_argnums=(5,))
+
+    def _exchange(buf, name):
+        """One engine alltoall round in its own replay-step bracket: the
+        steady-state exchange is exactly ONE fused engine dispatch."""
+        engine.step_begin()
+        try:
+            out = engine.grouped_alltoall([buf], name=name)[0].synchronize()
+        finally:
+            engine.step_end()
+        return out
+
+    def _tree_add(a, b):
+        if a is None:
+            return b
+        return jax.tree_util.tree_map(jnp.add, a, b)
+
+    def step(shared, expert, opt_state, tokens, targets):
+        b, t = tokens.shape
+        capacity = max(int(_math.ceil(b * t * capf / E)), 1)
+
+        # -- forward: jitted segments chained through engine exchanges ----
+        h, vjp0 = jax.vjp(lambda s: seg_embed(s, tokens), shared)
+        layer_bwd = []
+        aux_total = jnp.zeros((), jnp.float32)
+        for i in range(L):
+            (d_flat, aux, gatek, h_attn), vjp_a, (eidx, slot, counts) = \
+                jax.vjp(lambda s, hh: seg_route[i](s, hh, capacity),
+                        shared, h, has_aux=True)
+            if reg.enabled:
+                cs = np.asarray(counts)
+                for e in range(E):
+                    if cs[e]:
+                        m_tokens.inc(float(cs[e]), expert=str(e))
+                m_skew.set(float(cs.max() / max(cs.mean(), 1e-9)),
+                           layer=str(i))
+            r_flat = _exchange(d_flat, f"moe.dispatch.l{i}")
+            e_flat, vjp_b = jax.vjp(
+                lambda ex, rr: seg_ffn[i](ex, rr, capacity), expert, r_flat)
+            c_flat = _exchange(e_flat, f"moe.combine.l{i}")
+            h, vjp_c = jax.vjp(
+                lambda hh, cc, gg: seg_comb(hh, cc, gg, eidx, slot,
+                                            capacity),
+                h_attn, c_flat, gatek)
+            aux_total = aux_total + aux
+            layer_bwd.append((vjp_a, vjp_b, vjp_c))
+        loss, vjp_l = jax.vjp(lambda s, hh: seg_loss(s, hh, targets),
+                              shared, h)
+        loss = loss + aux_w * aux_total / L
+
+        # -- backward: reverse chain, transposed exchanges ----------------
+        g_shared = None
+        g_expert = None
+        g_aux = jnp.asarray(aux_w / L, jnp.float32)
+        gs_l, g_h = vjp_l(jnp.ones((), loss.dtype))
+        g_shared = _tree_add(g_shared, gs_l)
+        for i in reversed(range(L)):
+            vjp_a, vjp_b, vjp_c = layer_bwd[i]
+            g_hattn, g_c, g_gatek = vjp_c(g_h)
+            # the uniform block exchange is an involution: the vjp of
+            # alltoall is the same alltoall on the cotangents
+            g_e = _exchange(g_c, f"moe.combine.bwd.l{i}")
+            g_exp_i, g_r = vjp_b(g_e)
+            g_expert = _tree_add(g_expert, g_exp_i)
+            g_d = _exchange(g_r, f"moe.dispatch.bwd.l{i}")
+            gs_a, g_h2 = vjp_a((g_d, g_aux, g_gatek, g_hattn))
+            g_shared = _tree_add(g_shared, gs_a)
+            g_h = g_h2
+        gs_0, = vjp0(g_h)
+        g_shared = _tree_add(g_shared, gs_0)
+
+        # -- shared-grad + loss world mean: one replayable reduce round ---
+        if n > 1:
+            leaves, treedef = jax.tree_util.tree_flatten(g_shared)
+            engine.step_begin()
+            try:
+                hs = engine.grouped_allreduce(
+                    leaves + [loss.reshape(1)], name="moe.shared_grads",
+                    op=ReduceOp.AVERAGE)
+                outs = [hh.synchronize() for hh in hs]
+            finally:
+                engine.step_end()
+            g_shared = jax.tree_util.tree_unflatten(treedef, outs[:-1])
+            loss = outs[-1][0]
+
+        params = {"shared": shared, "expert": expert}
+        grads = {"shared": g_shared, "expert": g_expert}
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params["shared"], params["expert"], opt_state, loss
 
     return step
 

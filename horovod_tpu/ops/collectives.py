@@ -59,7 +59,7 @@ ALGO_HIERARCHICAL = "hierarchical"
 ALGORITHMS = (ALGO_FLAT, ALGO_TREE, ALGO_HIERARCHICAL)
 
 # kinds the selection layer covers; everything else is always flat
-_SELECTABLE_KINDS = ("allreduce", "reducescatter", "allgather")
+_SELECTABLE_KINDS = ("allreduce", "reducescatter", "allgather", "alltoall")
 
 _warned_demotions: set = set()
 
@@ -138,6 +138,13 @@ def choose_algorithm(kind: str, nbytes: int, topology,
       flat/hierarchical crossover (autotune/calibration.py: the ladder's
       extra launches cost α before its bandwidth win pays). The default
       0 keeps the nominal always-hierarchical behavior;
+    - alltoall takes the two-phase ICI-then-DCN exchange under the same
+      (factorization AND threshold) rule: the flat whole-world alltoall
+      pushes O(n) distinct chunks over every DCN link, while the
+      two-level form first exchanges within each slice (ICI) and then
+      moves O(n/slices) whole slice-blocks across DCN — the quadratic
+      DCN-hop fix. The engine passes alltoall its OWN calibrated
+      threshold (``Config.alltoall_hier_threshold_bytes``);
     - otherwise the flat ring.
 
     Deterministic in (kind, bytes, topology, knobs) — every rank that
@@ -154,7 +161,8 @@ def choose_algorithm(kind: str, nbytes: int, topology,
     if (kind == "allreduce" and nbytes <= tree_threshold_bytes
             and n >= 4 and _is_pow2(n)):
         return ALGO_TREE
-    if (kind in ("allreduce", "allgather") and topology.hierarchical_ok
+    if (kind in ("allreduce", "allgather", "alltoall")
+            and topology.hierarchical_ok
             and nbytes >= hier_threshold_bytes):
         return ALGO_HIERARCHICAL
     return ALGO_FLAT
@@ -162,7 +170,7 @@ def choose_algorithm(kind: str, nbytes: int, topology,
 
 def link_split(algo: str, nbytes: int, local_size: int,
                kind: str = "allreduce", codec: str = comp.CODEC_NONE,
-               itemsize: int = 4) -> dict:
+               itemsize: int = 4, size: int = 0) -> dict:
     """Per-fabric attribution of one bucket's payload bytes (the
     ``link`` label on ``hvd_tpu_wire_bytes_total``): each byte is counted
     once, attributed to the fabric that paces it.
@@ -174,6 +182,12 @@ def link_split(algo: str, nbytes: int, local_size: int,
       blocks — EVERY payload byte crosses DCN (the win there is one
       contiguous block transfer instead of a whole-world ring, not a
       byte reduction), so the full payload is attributed to DCN;
+    - hierarchical **alltoall**: the phase-2 block transpose carries the
+      (C-1)/C of the payload destined for OTHER slices over DCN (C =
+      ``size // local_size`` slices — ``size`` is required for this
+      kind, nothing else here needs the world size); the remaining 1/C
+      stays on the slice and is attributed to the ICI phase. The DCN
+      leg is the (optionally) encoded one;
     - every other lowering is whole-fabric ("flat").
 
     ``codec`` (ISSUE 13) shrinks the *encoded* leg: on the hierarchical
@@ -202,9 +216,13 @@ def link_split(algo: str, nbytes: int, local_size: int,
     if algo == ALGO_HIERARCHICAL and local_size > 1:
         if kind == "allgather":
             return {"dcn": nbytes}
+        if kind == "alltoall":
+            cross = max(size // local_size, 1)
+            dcn_raw = nbytes - nbytes // cross
+            return {"dcn": enc(dcn_raw), "ici": nbytes - dcn_raw}
         dcn_raw = nbytes // local_size
         return {"dcn": enc(dcn_raw), "ici": nbytes - dcn_raw}
-    if kind == "allgather":
+    if kind in ("allgather", "alltoall"):
         return {"flat": nbytes}
     if kind == "reducescatter":
         return {"flat": enc(nbytes)}
@@ -523,6 +541,62 @@ def alltoall_p(x, axis_name: str):
                           split_axis=0, concat_axis=0, tiled=False).reshape(x.shape)
 
 
+def hierarchical_alltoall_p(x, axis_name: str, n: int, local_size: int,
+                            codec: str = comp.CODEC_NONE):
+    """Two-phase equal-split alltoall for a slice-major (cross, local)
+    world: same routing result as :func:`alltoall_p`, different wire path.
+
+    Under the :func:`slice_groups` layout (rank r = c*L + l, C = n/L
+    slices of L ranks) the payload is viewed as (C, L, m, *s) chunk
+    blocks and exchanged in two hops:
+
+    - **phase 1 (ICI)**: an alltoall over each local group along the L
+      axis — after it, position ``[c', j]`` holds the chunk local peer
+      ``j`` wants delivered to rank ``c'*L + l_me``, i.e. every row
+      this rank must forward to slice ``c'`` is now resident as ONE
+      contiguous block;
+    - **phase 2 (DCN)**: an alltoall over each cross group along the C
+      axis — whole slice-blocks transpose across slices, so each DCN
+      link carries C-1 blocks of n*m/C rows instead of the flat form's
+      n-1 per-rank chunks: O(n/slices) DCN transfers, the quadratic
+      DCN-hop fix.
+
+    Pure chunk routing, no arithmetic — the result is bitwise-equal to
+    the flat alltoall (codec "none"). ``codec`` encodes ONLY the phase-2
+    (DCN) payload — stateless, no error-feedback residual: dispatched
+    tokens have no stable step-over-step identity for a residual to
+    telescope against (unlike gradient buckets), so the quantization is
+    one-shot and the ICI phase stays full precision (the ISSUE 13
+    per-link placement rule). With a codec the output is NOT bitwise
+    flat-equal. Scales ride a (C,)-gather over the cross group so each
+    received block decodes with its sender's scale.
+    """
+    L = int(local_size)
+    C = n // L
+    local_groups, cross_groups = slice_groups(n, L)
+    m = x.shape[0] // n
+    blk = x.reshape(C, L, m, *x.shape[1:])
+    # phase 1 — ICI: axis 1 has size L == local group size; tiled=False
+    # consumes it and re-inserts the group-size axis in place
+    y = lax.all_to_all(blk, axis_name, split_axis=1, concat_axis=1,
+                       tiled=False, axis_index_groups=local_groups)
+    if codec == comp.CODEC_NONE:
+        z = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
+                           tiled=False, axis_index_groups=cross_groups)
+    else:
+        payload, scale = comp.encode(y, codec)
+        z = lax.all_to_all(payload, axis_name, split_axis=0, concat_axis=0,
+                           tiled=False, axis_index_groups=cross_groups)
+        if scale is None:   # bf16: plain cast, no scale exchange
+            z = comp.decode(z, None, codec, x.dtype)
+        else:
+            scales = lax.all_gather(scale, axis_name, axis=0, tiled=True,
+                                    axis_index_groups=cross_groups)
+            z = comp.decode(z, scales.reshape((C,) + (1,) * (z.ndim - 1)),
+                            codec, x.dtype)
+    return z.reshape(x.shape)
+
+
 def reducescatter_p(x, axis_name: str, op: ReduceOp = ReduceOp.SUM):
     """Reduce-scatter along dim 0 (NCCL ReduceScatter analog,
     nccl_operations.cc:227-277). Only Sum and Average are defined."""
@@ -740,6 +814,103 @@ def build_alltoall(mesh: Mesh, axis: str):
         return alltoall_p(x[0], axis)[None]
 
     fn = _shmap(body, mesh, axis, in_specs=P(axis), out_specs=P(axis))
+    return jax.jit(fn)
+
+
+def _a2a_pack(tensors, n: int):
+    """View each (d0_i, *s_i) dispatch tensor as its (n, w_i) chunk matrix
+    (row j = this rank's chunk bound for rank j) and concatenate the rows:
+    the fusion pack for an alltoall bucket. Returns ``(packed, widths)``."""
+    parts = [t.reshape(n, -1) for t in tensors]
+    widths = [p.shape[1] for p in parts]
+    packed = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+    return packed, widths
+
+
+def _a2a_exchange(packed, axis: str, n: int, local_size: int, algo, codec):
+    """One bucket's wire exchange: the per-bucket algo dispatch shared by
+    the grouped builder and the replay "a2a" segment (``algo`` must be
+    pre-validated; None means flat)."""
+    if algo == ALGO_HIERARCHICAL:
+        return hierarchical_alltoall_p(packed, axis, n, local_size, codec)
+    return alltoall_p(packed, axis)
+
+
+def build_hierarchical_alltoall(mesh: Mesh, axis: str, local_size: int,
+                                codec: str = comp.CODEC_NONE):
+    """Stacked two-level alltoall (:func:`hierarchical_alltoall_p`):
+    (n, d0, *s) -> (n, d0, *s), d0 % n == 0, identical routing result to
+    :func:`build_alltoall` with the DCN hop count cut to O(n/slices).
+    ``codec`` encodes the phase-2 (DCN) leg only — stateless, no
+    residual (see the primitive's docstring). A world the ``local_size``
+    does not factorize demotes to the flat builder with a one-time
+    WARNING (never an assert)."""
+    n = int(mesh.devices.size)
+    if validate_algorithm("alltoall", ALGO_HIERARCHICAL, n,
+                          local_size) != ALGO_HIERARCHICAL:
+        return build_alltoall(mesh, axis)
+
+    def body(x):  # (1, d0, *s); output varies per rank like the flat form
+        return hierarchical_alltoall_p(x[0], axis, n, local_size, codec)[None]
+
+    # sub-group exchanges defeat the VMA checker's inference; the output
+    # claims exactly what the flat builder's does (per-rank varying)
+    fn = _shmap(body, mesh, axis, in_specs=P(axis), out_specs=P(axis),
+                check_vma=False)
+    return jax.jit(fn)
+
+
+def build_grouped_alltoall(mesh: Mesh, axis: str, shapes, dtypes, buckets,
+                           local_size: int = 0,
+                           algos: Optional[Sequence[str]] = None,
+                           codecs: Optional[Sequence[str]] = None):
+    """ONE launch for a whole fusion group of same-shaped(-enough)
+    alltoall dispatch tensors — the alltoall analog of
+    :func:`build_grouped_allreduce`, closing the last fusion-bucketing
+    gap in the engine's op surface. Per bucket: every member tensor
+    (d0_i, *s_i) with d0_i % n == 0 is viewed as its (n, w_i) chunk
+    matrix (row j = the chunk bound for rank j) and the rows are
+    concatenated to ONE (n, R_b) buffer — a single whole-bucket exchange
+    replaces len(bucket) wire launches, then per-tensor columns unpack.
+    Chunk-matrix packing keeps per-destination data contiguous, so the
+    pack IS the fusion: no per-destination re-gather inside the
+    exchange.
+
+    ``algos``/``codecs`` follow the grouped-allreduce per-bucket
+    convention: algo None resolves flat, hierarchical takes the
+    :func:`hierarchical_alltoall_p` two-phase path (invalid forcings
+    demote with a one-time WARNING), and the codec applies to the DCN
+    leg of hierarchical buckets only — a flat bucket ignores its codec
+    (there is no slow-link leg to encode; the ISSUE 13 placement rule,
+    not an oversight)."""
+    _check_bucket_dtypes(dtypes, buckets)
+    n = int(mesh.devices.size)
+    if algos is None:
+        algos = (None,) * len(buckets)
+    algos = tuple(
+        validate_algorithm("alltoall", a if a is not None else ALGO_FLAT,
+                           n, local_size)
+        for a in algos)
+    if codecs is None:
+        codecs = (comp.CODEC_NONE,) * len(buckets)
+    codecs = tuple(codecs)
+
+    def body(*xs):  # per tensor: (1, d0_i, *s_i)
+        outs = [None] * len(shapes)
+        for b, idxs in enumerate(buckets):
+            packed, widths = _a2a_pack([xs[i][0] for i in idxs], n)
+            out = _a2a_exchange(packed, axis, n, local_size, algos[b],
+                                codecs[b])
+            off = 0
+            for i, w in zip(idxs, widths):
+                outs[i] = out[:, off:off + w].reshape(shapes[i])[None]
+                off += w
+        return tuple(outs)
+
+    fn = _shmap(body, mesh, axis,
+                in_specs=tuple(P(axis) for _ in shapes),
+                out_specs=tuple(P(axis) for _ in shapes),
+                check_vma=False)
     return jax.jit(fn)
 
 
@@ -1594,6 +1765,12 @@ def replay_residual_layout(segments, n: int) -> list:
     out = []
     for si, seg in enumerate(segments):
         cls, code, pre, post, topo_field, shapes, buckets = seg
+        if cls == "a2a":
+            # the alltoall DCN-leg codec is stateless by design (dispatched
+            # tokens have no step-over-step identity for a residual to
+            # telescope against) — never a residual row, even for codecs
+            # that carry one on reduce segments
+            continue
         local, algos, codecs = _seg_algo_spec(topo_field, len(buckets))
         sizes = [math.prod(s) for s in shapes]
         for bi, idxs in enumerate(buckets):
@@ -1632,12 +1809,20 @@ def build_replay_step(mesh: Mesh, axis: str, segments,
     Args:
       segments: sequence of ``(cls, code, pre, post, local_size, shapes,
         buckets)`` tuples — ``cls`` is ``"reduce"`` (code = ReduceOp),
-        ``"bcast"`` (code = root rank), or ``"sharded"`` (a ZeRO-1
-        optimizer step: code = ``(op, update_key, n_grads)``, ``shapes``
-        lists the gradient shapes followed by the shard-local state-leaf
-        shapes, ``buckets`` index into the first ``n_grads`` shapes, and
-        ``update_key`` resolves the shard-update closure in
-        ``sharded_updates``); other ``shapes``/``buckets`` as before.
+        ``"bcast"`` (code = root rank), ``"a2a"`` (an alltoall dispatch
+        group: code unused, per-bucket algos/codecs ride the topology
+        field exactly as for reduce segments, and the codec applies to
+        the hierarchical DCN leg only — stateless, no residual row), or
+        ``"sharded"`` (a ZeRO-1 optimizer step: code = ``(op,
+        update_key, n_grads)``, ``shapes`` lists the gradient shapes
+        followed by the shard-local state-leaf shapes, ``buckets`` index
+        into the first ``n_grads`` shapes, and ``update_key`` resolves
+        the shard-update closure in ``sharded_updates``); other
+        ``shapes``/``buckets`` as before. An ``"a2a"`` segment's inputs
+        and outputs ride the same world-view P() claim as everything
+        else — each rank's addressable shard is its OWN dispatch/receive
+        buffer, which is exactly what the one-device-per-process group
+        mesh extracts.
       sharded_updates: mapping update_key -> ``update(shards, state)``
         closure (engine._sharded_updates); required when any segment is
         ``"sharded"``.
@@ -1674,10 +1859,14 @@ def build_replay_step(mesh: Mesh, axis: str, segments,
             bases.append(base)
             base += len(seg[5])
         # -- phase 1: every bucket's pack (pre-scaled), no collective yet --
-        packs = {}   # (seg_idx, bucket_idx) -> flat
+        packs = {}   # (seg_idx, bucket_idx) -> flat (or (n, R) for a2a)
         for si, (cls, code, pre, post, local_size, shapes,
                  buckets) in enumerate(segments):
             for bi, idxs in enumerate(buckets):
+                if cls == "a2a":
+                    packs[(si, bi)], _ = _a2a_pack(
+                        [ts[bases[si] + i] for i in idxs], n)
+                    continue
                 flat = jnp.concatenate(
                     [jnp.ravel(ts[bases[si] + i]) for i in idxs])
                 if cls != "bcast" and pre != 1.0:
@@ -1712,6 +1901,10 @@ def build_replay_step(mesh: Mesh, axis: str, segments,
                     if (si, bi) in res_in:
                         new_res[(si, bi)] = nr
                     reds[(si, bi)] = red
+                elif cls == "a2a":
+                    reds[(si, bi)] = _a2a_exchange(flat, axis, n,
+                                                   local_size, algos[bi],
+                                                   codecs[bi])
                 else:
                     reds[(si, bi)] = broadcast_p(flat, axis, code)
         # -- phase 3: shard-local updates + return all-gathers --
@@ -1744,6 +1937,15 @@ def build_replay_step(mesh: Mesh, axis: str, segments,
                  buckets) in enumerate(segments):
             sizes = [math.prod(s) for s in shapes]
             for bi, idxs in enumerate(buckets):
+                if cls == "a2a":
+                    ex = reds[(si, bi)]
+                    off = 0
+                    for i in idxs:
+                        w = sizes[i] // n
+                        outs[bases[si] + i] = \
+                            ex[:, off:off + w].reshape(shapes[i])
+                        off += w
+                    continue
                 seg_outs = [None] * len(shapes)
                 _unpack_flat(reds[(si, bi)], shapes, sizes, idxs, seg_outs)
                 for i in idxs:
@@ -1797,6 +1999,19 @@ def build_replay_step(mesh: Mesh, axis: str, segments,
                         outs[base + i] = seg_outs[i]
                 for j, leaf in enumerate(new_state):
                     outs[base + n_grads + j] = leaf
+                base += len(shapes)
+                continue
+            if cls == "a2a":
+                for b, idxs in enumerate(buckets):
+                    packed, widths = _a2a_pack(
+                        [ts[base + i] for i in idxs], n)
+                    ex = _a2a_exchange(packed, axis, n, local_size,
+                                       algos[b], codecs[b])
+                    off = 0
+                    for i, w in zip(idxs, widths):
+                        outs[base + i] = \
+                            ex[:, off:off + w].reshape(shapes[i])
+                        off += w
                 base += len(shapes)
                 continue
             if cls == "reduce":

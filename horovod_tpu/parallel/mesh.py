@@ -117,6 +117,12 @@ class Topology:
         - hierarchical allgather: the cross gather moves whole slice
           blocks (every byte crosses DCN) — DCN-paced like the flat
           multislice ring; its win is hop count, not bandwidth.
+        - alltoall: busbw convention is (n-1)/n (each rank keeps its own
+          chunk; only n-1 of n chunks move). Flat is paced like the ring
+          — DCN when the world spans islands. The hierarchical lowering's
+          DCN leg carries only the cross-slice block transpose — each DCN
+          link moves (C-1)/C of the payload instead of (n-1)/n across C
+          slices, so the ceiling is min(ici, dcn · (n-1)/n ÷ (C-1)/C).
         - tree (recursive doubling): each of the log2(n) rounds moves the
           full payload, so the bandwidth ceiling divides by log2(n) —
           the reason tree is for latency-bound small buckets only.
@@ -124,6 +130,17 @@ class Topology:
         n = max(self.size, 1)
         if n <= 1:
             return float("inf")
+        if kind == "alltoall":
+            if algo == "hierarchical" and self.hierarchical_ok:
+                c = self.num_slices
+                if c <= 1:
+                    return self.ici_gbps
+                # normalized by the flat (n-1)/n convention: the DCN leg
+                # only moves (C-1)/C, so the effective ceiling scales up
+                # by the block-transpose factor ((n-1)/n) / ((C-1)/C)
+                factor = ((n - 1) / n) / ((c - 1) / c)
+                return min(self.ici_gbps, self.dcn_gbps * factor)
+            return self.dcn_gbps if self.is_multislice else self.ici_gbps
         if algo == "hierarchical" and self.hierarchical_ok:
             if kind == "allgather":
                 return min(self.ici_gbps, self.dcn_gbps)

@@ -28,9 +28,11 @@ import numpy as np
 import pytest
 
 from horovod_tpu.autotune.calibration import (
-    HIER_THRESHOLD_MAX, TREE_THRESHOLD_MAX, TREE_THRESHOLD_MIN,
-    derived_hier_threshold_bytes, derived_thresholds,
-    derived_tree_threshold_bytes, fit_alpha_beta, fit_measured_topology)
+    A2A_CLASS_FLAT, A2A_CLASS_HIER, HIER_THRESHOLD_MAX,
+    TREE_THRESHOLD_MAX, TREE_THRESHOLD_MIN,
+    derived_alltoall_threshold_bytes, derived_hier_threshold_bytes,
+    derived_thresholds, derived_tree_threshold_bytes, fit_alpha_beta,
+    fit_measured_topology)
 from horovod_tpu.autotune.parameter_manager import ParameterManager
 from horovod_tpu.autotune.persistence import (TuningStore, kv_key,
                                               record_filename)
@@ -168,6 +170,59 @@ class TestMeasuredTopology:
         assert C.choose_algorithm("allreduce", 1 * MB, topo,
                                   tree_threshold_bytes=0) == \
             C.ALGO_HIERARCHICAL
+
+
+class TestAlltoallCalibrationBand:
+    """ISSUE 17: the alltoall band fits its own α–β rows and derives a
+    measured flat-vs-hierarchical dispatch crossover."""
+
+    BANDS = (64e3, 512e3, 4e6)
+
+    def test_a2a_rows_fit_and_derive_finite_crossover(self):
+        base = Topology(size=8, local_size=4, platform="cpu")
+        agreed = {
+            "flat": [1e-4 + s / 1e9 for s in self.BANDS],
+            "hierarchical": [3e-4 + s / 3e9 for s in self.BANDS],
+            A2A_CLASS_FLAT: [1e-4 + s / 2e9 for s in self.BANDS],
+            A2A_CLASS_HIER: [4e-4 + s / 8e9 for s in self.BANDS],
+        }
+        m = fit_measured_topology(base, agreed, bands=self.BANDS)
+        # the extra classes ride the same fit: rows present and sane
+        a_f, b_f = m.fitted(A2A_CLASS_FLAT)
+        a_h, b_h = m.fitted(A2A_CLASS_HIER)
+        assert a_f == pytest.approx(1e-4, rel=1e-3)
+        assert b_f == pytest.approx(2e9, rel=1e-3)
+        assert a_h == pytest.approx(4e-4, rel=1e-3)
+        assert b_h == pytest.approx(8e9, rel=1e-3)
+        thr = derived_alltoall_threshold_bytes(m)
+        assert thr is not None and 0 < thr < HIER_THRESHOLD_MAX
+        # crossover: flat and hier cost curves meet exactly there
+        assert a_f + thr / b_f == pytest.approx(a_h + thr / b_h,
+                                                rel=1e-3)
+        # the alltoall band never perturbs the allreduce crossovers
+        tree_thr, hier_thr = derived_thresholds(m)
+        assert 0 < hier_thr < HIER_THRESHOLD_MAX
+
+    def test_unprobed_band_returns_none(self):
+        base = Topology(size=8, local_size=4, platform="cpu")
+        agreed = {
+            "flat": [1e-4 + s / 1e9 for s in self.BANDS],
+            A2A_CLASS_FLAT: [1e-4 + s / 2e9 for s in self.BANDS],
+        }
+        m = fit_measured_topology(base, agreed, bands=self.BANDS)
+        # hierarchical leg unprobed (single slice, or probe vetoed):
+        # no measured crossover — the nominal default stays in force
+        assert derived_alltoall_threshold_bytes(m) is None
+        assert derived_alltoall_threshold_bytes(
+            fit_measured_topology(base,
+                                  {"flat": agreed["flat"]},
+                                  bands=self.BANDS)) is None
+
+    def test_busbw_convention_alltoall(self):
+        from horovod_tpu.autotune.calibration import _busbw_factor
+        assert _busbw_factor("alltoall", 8) == pytest.approx(7 / 8)
+        assert _busbw_factor("allgather", 8) == pytest.approx(7 / 8)
+        assert _busbw_factor("allreduce", 8) == pytest.approx(2 * 7 / 8)
 
 
 # ---------------------------------------------------------------------------
@@ -684,7 +739,8 @@ def test_probe_fits_real_programs():
     assert world.topology.hierarchical_ok
     bands = (16 * 1024, 64 * 1024, 256 * 1024)
     local = probe_link_times(world, bands=bands)
-    assert set(local) == {"flat", "tree", "hierarchical"}
+    assert set(local) == {"flat", "tree", "hierarchical",
+                          A2A_CLASS_FLAT, A2A_CLASS_HIER}
     assert all(len(v) == len(bands) and all(t > 0 for t in v)
                for v in local.values())
     agreed = agree_times(world, local)
@@ -698,6 +754,10 @@ def test_probe_fits_real_programs():
     tree_thr, hier_thr = derived_thresholds(m)
     assert TREE_THRESHOLD_MIN <= tree_thr <= TREE_THRESHOLD_MAX
     assert 0 <= hier_thr <= HIER_THRESHOLD_MAX
+    # both alltoall legs probed on this world: a measured crossover
+    a2a_thr = derived_alltoall_threshold_bytes(m)
+    assert a2a_thr is not None
+    assert 0 <= a2a_thr <= HIER_THRESHOLD_MAX
 
 
 # ---------------------------------------------------------------------------

@@ -691,3 +691,82 @@ def test_grouped_allreduce_hierarchical_ladder():
                                    rtol=1e-5, atol=1e-5)
         np.testing.assert_allclose(np.asarray(outs[idxs[1]]), expect[32:],
                                    rtol=1e-5, atol=1e-5)
+
+
+def test_hierarchical_alltoall_lowers_to_two_level_exchange():
+    """ISSUE 17 acceptance (IR structure): the two-phase alltoall on the
+    8-device world with local_size=4 must lower to exactly TWO
+    all-to-alls — one over the intra-slice (ICI) groups {0-3}/{4-7}, one
+    over the cross-slice (DCN) column groups {0,4}/{1,5}/... — while the
+    forced-flat program collapses to ONE whole-world all-to-all; both
+    routings are pure chunk moves, so the outputs are bitwise-equal."""
+    mesh = _world_mesh()
+    hfn = C.build_hierarchical_alltoall(mesh, "world", 4)
+    ffn = C.build_alltoall(mesh, "world")
+    x = jax.device_put(
+        jnp.arange(8 * 16 * 3, dtype=jnp.float32).reshape(8, 16, 3),
+        NamedSharding(mesh, P("world")))
+    hhlo = _hlo(hfn, x)
+    fhlo = _hlo(ffn, x)
+    assert _count(r"all-to-all(?:-start)?\(", hhlo) == 2, \
+        "two-phase program did not lower to exactly two exchanges"
+    assert _count(r"all-to-all(?:-start)?\(", fhlo) == 1, \
+        "flat program is not one whole-world exchange"
+    hflat = hhlo.replace(" ", "")
+    assert re.search(r"replica_groups=\{\{0,1,2,3\},\{4,5,6,7\}\}", hflat), \
+        "no intra-slice (ICI) replica groups in the two-phase HLO"
+    assert re.search(r"replica_groups=\{\{0,4\},\{1,5\},\{2,6\},\{3,7\}\}",
+                     hflat), \
+        "no cross-slice (DCN) replica groups in the two-phase HLO"
+    assert re.search(r"replica_groups=\{\{0,1,2,3,4,5,6,7\}\}",
+                     fhlo.replace(" ", "")), \
+        "flat exchange is not whole-world"
+    np.testing.assert_array_equal(
+        np.asarray(jax.block_until_ready(hfn(x))),
+        np.asarray(jax.block_until_ready(ffn(x))))
+
+
+def test_grouped_alltoall_per_bucket_algos_structure():
+    """Per-bucket alltoall selection in ONE grouped program: a flat
+    bucket contributes one whole-world all-to-all, a hierarchical bucket
+    two sliced ones — three exchanges total, numerics identical to
+    all-flat."""
+    mesh = _world_mesh()
+    shapes = ((16, 4), (24, 4))
+    dtypes = [jnp.float32] * 2
+    buckets = [[0], [1]]
+    mixed = C.build_grouped_alltoall(
+        mesh, "world", shapes, dtypes, buckets, local_size=4,
+        algos=(C.ALGO_FLAT, C.ALGO_HIERARCHICAL))
+    flat = C.build_grouped_alltoall(
+        mesh, "world", shapes, dtypes, buckets, local_size=4,
+        algos=(C.ALGO_FLAT, C.ALGO_FLAT))
+    rng = np.random.RandomState(0)
+    args = [jax.device_put(
+        jnp.asarray(rng.randn(8, *s).astype(np.float32)),
+        NamedSharding(mesh, P("world"))) for s in shapes]
+    hlo = _hlo(mixed, *args)
+    assert _count(r"all-to-all(?:-start)?\(", hlo) == 3, \
+        "expected 1 flat + 2 hierarchical-phase exchanges"
+    for a, b in zip(mixed(*args), flat(*args)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_reducescatter_selection_stays_flat():
+    """The ISSUE 17 selection surface is alltoall-only on the scatter
+    side: reducescatter never takes the hierarchical ladder (auto OR
+    forced — forcing demotes), even on a fabric where allreduce and
+    alltoall both would."""
+    from horovod_tpu.parallel.mesh import Topology
+    topo = Topology(size=8, local_size=4, platform="tpu", source="test")
+    nbytes = 32 * 1024 ** 2
+    assert C.choose_algorithm("allreduce", nbytes, topo,
+                              tree_threshold_bytes=0) == \
+        C.ALGO_HIERARCHICAL
+    assert C.choose_algorithm("alltoall", nbytes, topo,
+                              tree_threshold_bytes=0) == \
+        C.ALGO_HIERARCHICAL
+    assert C.choose_algorithm("reducescatter", nbytes, topo,
+                              tree_threshold_bytes=0) == C.ALGO_FLAT
+    assert C.validate_algorithm("reducescatter", C.ALGO_HIERARCHICAL,
+                                8, 4) == C.ALGO_FLAT

@@ -1175,3 +1175,105 @@ def test_np2_calibrated_selection_deterministic():
     assert r0["hier_thr"] == r1["hier_thr"]
     # the frozen bucket-layout digest (the persistence key) agrees too
     assert r0["model_sig"] == r1["model_sig"] is not None
+
+
+def _worker_uneven_alltoall_wire_bytes():
+    """ISSUE 17 satellite: the uneven alltoall pads every chunk to the
+    world max inside the program, but wire accounting must book the
+    SUBMITTED payload (x.nbytes, pre-padding) — and the splits exchange
+    must go meta-cache hot on the repeat call with identical results."""
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+    from horovod_tpu.metrics import registry
+
+    rank, size = hvd.rank(), hvd.size()
+
+    def a2a_wire_bytes():
+        ent = registry().snapshot()["counters"].get(
+            "hvd_tpu_wire_bytes_total", {})
+        return sum(v for l, v in ent.get("values", [])
+                   if l.get("kind") == "alltoall")
+
+    d = 3
+    out = {"rank": rank}
+    # rank 0 sends 1 row per peer, rank 1 sends 3 (max chunk 3: rank 0's
+    # program pads 2 rows per chunk — those must NOT be counted)
+    splits = [1 + 2 * rank] * size
+    x = np.full((sum(splits), d), float(100 * rank), np.float32)
+    base = a2a_wire_bytes()
+    recv, counts = hvd.alltoall(x, splits=splits, name="uw.0")
+    out["counts0"] = np.asarray(counts).tolist()
+    out["recv0"] = np.asarray(recv)[:, 0].tolist()
+    out["wire_delta"] = a2a_wire_bytes() - base
+    out["payload_bytes"] = int(x.nbytes)
+    out["padded_bytes"] = int(size * max(1 + 2 * r for r in range(size))
+                              * d * 4)
+    # repeat with the SAME splits: the cache goes hot at streak 2, after
+    # which the sizes exchange costs zero blocking fetches and the
+    # routing stays identical
+    eng = hvd._engine()
+    hvd.alltoall(x, splits=splits, name="uw.0")     # streak 2 -> hot
+    f0 = eng.host_fetches
+    recv2, counts2 = hvd.alltoall(x, splits=splits, name="uw.0")
+    out["counts_repeat"] = np.asarray(counts2).tolist()
+    out["recv_equal"] = bool(
+        np.array_equal(np.asarray(recv), np.asarray(recv2)))
+    out["extra_fetches"] = eng.host_fetches - f0
+    return out
+
+
+@pytest.mark.integration
+def test_uneven_alltoall_padding_not_counted_as_wire_bytes():
+    from horovod_tpu.runner import run
+    r0, r1 = run(_worker_uneven_alltoall_wire_bytes, np=2, env=_mp_env())
+    for r in (r0, r1):
+        # submitted-payload accounting: exactly x.nbytes, and the padded
+        # program is strictly bigger, so the distinction is observable
+        assert r["wire_delta"] == r["payload_bytes"], r
+        assert r["padded_bytes"] >= r["payload_bytes"]
+        assert r["recv_equal"], r
+        # hot meta cache: the repeat call's splits exchange costs zero
+        # blocking host fetches
+        assert r["extra_fetches"] == 0, r
+    # rank 0 ships 24 B against a 72 B padded program: the 48 B of
+    # padding must be invisible to the wire counter
+    assert r0["padded_bytes"] > r0["payload_bytes"]
+    # recv splits through the exchanged matrix: recv_splits[r] = sender
+    # r's split for me — rank0 receives [1, 3], rank1 receives [1, 3]
+    assert r0["counts0"] == [1, 3] and r1["counts0"] == [1, 3]
+    assert r0["counts_repeat"] == r0["counts0"]
+    assert r0["recv0"] == [0.0] * 1 + [100.0] * 3, r0
+    assert r1["recv0"] == [0.0] * 1 + [100.0] * 3, r1
+
+
+def _worker_noop_teardown():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+    return hvd.rank()
+
+
+@pytest.mark.integration
+def test_static_world_teardown_has_no_shutdown_order_stall():
+    """Static (non-recoverable) worlds must tear down through the
+    coordination service's own shutdown barrier, NOT the elastic KV
+    ordering protocol: with the barrier present, a non-zero rank's
+    jax.distributed.shutdown() blocks inside the barrier until rank 0
+    enters it, so the KV flag could only ever be posted after rank 0
+    exhausted the full ordering deadline — every np>1 run paid
+    HOROVOD_TPU_SHUTDOWN_ORDER_TIMEOUT (default 10 s) of dead wait at
+    exit. With the deadline pinned far above the real teardown cost,
+    finishing under it proves the KV wait never ran."""
+    import time
+    from horovod_tpu.runner import run
+    env = _mp_env()
+    env["HOROVOD_TPU_SHUTDOWN_ORDER_TIMEOUT"] = "60"
+    t0 = time.monotonic()
+    r = run(_worker_noop_teardown, np=2, env=env)
+    elapsed = time.monotonic() - t0
+    assert sorted(r) == [0, 1]
+    assert elapsed < 60, (
+        f"teardown took {elapsed:.1f}s — the static world fell back to "
+        "the elastic KV shutdown-ordering wait")

@@ -376,3 +376,52 @@ def test_pipeline_schedule_smoke_2stage():
     jax.block_until_ready(loss)
     assert np.isfinite(float(loss))
     assert eng.replay.captured_streams > captured0   # replay capture fired
+
+
+def test_pp_moe_composition_2stage_2expert_bitwise_reproducible():
+    """ISSUE 17 satellite: PP x MoE-EP composition — the 2-stage pipeline
+    flagship with the MoE FFN (2 experts per stage-local layer) trains
+    through make_pp_engine_train_step, loss improves, and the whole loss
+    trajectory is bitwise-reproducible from identical state (fresh
+    replay both runs, so capture/arm/replay paths line up too)."""
+    import optax
+    import horovod_tpu as hvd
+    from horovod_tpu.models import transformer as tfm
+    from horovod_tpu.optimizer import DistributedEagerOptimizer
+
+    cfg = tfm.TransformerConfig(vocab_size=32, d_model=16, n_heads=2,
+                                n_layers=2, d_ff=32, max_seq=8,
+                                dtype=jnp.float32, attention="flash",
+                                use_moe=True, n_experts=2,
+                                moe_capacity_factor=2.0)
+    mesh = Mesh(np.array(jax.devices()[:2]), (tfm.PIPE_AXIS,))
+    specs = tfm.pp_param_specs(cfg)
+    assert "router" in specs["layers"], "MoE pp specs must place the router"
+    rng = np.random.RandomState(11)
+    tok = jnp.asarray(rng.randint(0, 32, size=(4, 8)).astype(np.int32))
+    tgt = jnp.asarray(rng.randint(0, 32, size=(4, 8)).astype(np.int32))
+    hvd.init()
+    eng = hvd._engine()
+
+    def trajectory():
+        eng.replay.invalidate_all("test isolation")
+        params = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(
+                np.asarray(x), NamedSharding(mesh, s)),
+            tfm.init_params(jax.random.PRNGKey(8), cfg), specs)
+        opt = DistributedEagerOptimizer(optax.sgd(0.05), sharded=True,
+                                        op=hvd.Sum)
+        st = opt.init(params)
+        step = tfm.make_pp_engine_train_step(mesh, cfg, opt, n_micro=4,
+                                             schedule="1f1b")
+        out = []
+        for _ in range(4):
+            params, st, loss = step(params, st, tok, tgt)
+            out.append(float(loss))
+        return out
+
+    l1 = trajectory()
+    l2 = trajectory()
+    assert l1 == l2, "PP x MoE trajectory must be bitwise-reproducible"
+    assert all(np.isfinite(v) for v in l1)
+    assert l1[-1] < l1[0], l1

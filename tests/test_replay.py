@@ -324,3 +324,124 @@ def test_eager_optimizer_wraps_steps(engine, monkeypatch):
     opt.reduce_gradients(grads) if engine.backend.size() > 1 else \
         opt._reduce_async(list(grads.values()), [None])
     assert calls == ["begin", "end"]
+
+
+# ---------------------------------------------------------------------------
+# alltoall replay (ISSUE 17): even-split grouped dispatch arms/replays,
+# the uneven eager form stays on the observe path, knob moves re-arm
+# ---------------------------------------------------------------------------
+
+def _a2a_step(eng, tensors, tag):
+    eng.step_begin()
+    hs = eng.grouped_alltoall(list(tensors), name=tag)
+    out = [h.synchronize() for h in hs]
+    eng.step_end()
+    return out
+
+
+def test_grouped_alltoall_stream_replays(engine):
+    """Even-split grouped_alltoall takes intercept (it returns bare
+    tensors, so a ReplayHandle can stand in): capture -> arm -> replay.
+    Size-1 alltoall is identity, so values check exactly."""
+    rng = np.random.RandomState(1)
+    a = jnp.asarray(rng.randn(6, 3).astype(np.float32))
+    b = jnp.asarray(rng.randn(4).astype(np.float32))
+    for i in range(4):
+        out = _a2a_step(engine, (a, b), f"a2a.{i}")
+        np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(a))
+        np.testing.assert_array_equal(np.asarray(out[1]), np.asarray(b))
+    assert engine.replay.captured_streams == 1
+    assert engine.replay.replayed_steps == 2
+    assert engine.replay.fallbacks == 0
+
+
+def test_replayed_alltoall_step_is_single_dispatch(engine):
+    rng = np.random.RandomState(2)
+    a = jnp.asarray(rng.randn(8, 2).astype(np.float32))
+    for i in range(3):
+        _a2a_step(engine, (a,), f"a2a1.{i}")
+    d0 = engine.dispatch_count
+    out = _a2a_step(engine, (a,), "a2a1.9")
+    assert engine.dispatch_count - d0 == 1, \
+        "a replayed alltoall step must be exactly ONE engine dispatch"
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(a))
+
+
+def test_uneven_alltoall_keeps_observe_path(engine):
+    """The uneven-capable eager alltoall yields (tensor, recv_splits) —
+    a ReplayHandle cannot stand in for that pair, so it must observe
+    (never arm), exactly like allgather."""
+    a = jnp.asarray(np.arange(6.0, dtype=np.float32).reshape(6, 1))
+    for i in range(5):
+        engine.step_begin()
+        out, counts = engine.alltoall(a, splits=[6],
+                                      name=f"ua.{i}").synchronize()
+        engine.step_end()
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(a))
+        assert list(np.asarray(counts)) == [6]
+    assert engine.replay.captured_streams == 0
+    assert engine.replay.replayed_steps == 0
+
+
+def test_alltoall_algo_knob_move_rearms(engine):
+    """A live HOROVOD_TPU_ALLTOALL_ALGO move lands in _algo_sig, so the
+    armed a2a stream rebuilds instead of replaying a stale program."""
+    rng = np.random.RandomState(3)
+    a = jnp.asarray(rng.randn(4, 5).astype(np.float32))
+    prev = engine.config.alltoall_algo
+    try:
+        for i in range(3):
+            _a2a_step(engine, (a,), f"ka.{i}")
+        assert engine.replay.replayed_steps == 1
+        armed = [e["armed"] for e in engine.replay._seen.values()
+                 if e.get("armed")]
+        assert armed and armed[0].algo_sig[6] == prev
+        engine.config.alltoall_algo = "flat"
+        out = _a2a_step(engine, (a,), "ka.3")
+        np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(a))
+        rearmed = [e["armed"] for e in engine.replay._seen.values()
+                   if e.get("armed")]
+        assert rearmed and rearmed[0].algo_sig[6] == "flat"
+    finally:
+        engine.config.alltoall_algo = prev
+
+
+def test_moe_ep_steady_state_one_dispatch_per_round(engine):
+    """ISSUE 17 acceptance: the steady-state MoE-EP train step's exchange
+    rounds each replay as exactly ONE fused engine dispatch — 4·L
+    alltoall rounds on the size-1 world (the shared-grad allreduce round
+    is skipped at n=1), zero fallbacks, finite loss."""
+    import jax
+    import optax
+    from horovod_tpu.models.transformer import (
+        TransformerConfig, init_params, make_moe_ep_train_step,
+        moe_ep_partition)
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq=16,
+                            dtype=jnp.float32, attention="flash",
+                            use_moe=True, n_experts=4,
+                            moe_capacity_factor=2.0)
+    opt = optax.sgd(0.1)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    shared, expert = moe_ep_partition(
+        params, engine.backend.rank(), engine.backend.size(), cfg)
+    step = make_moe_ep_train_step(engine, cfg, opt)
+    st = (shared, expert, opt.init({"shared": shared, "expert": expert}))
+    rng = np.random.RandomState(0)
+    tok = jnp.asarray(rng.randint(0, 64, (2, 16)), jnp.int32)
+    tgt = jnp.asarray(rng.randint(0, 64, (2, 16)), jnp.int32)
+    first = None
+    for _ in range(2):      # warmup: every exchange stream arms
+        *st, loss = step(*st, tok, tgt)
+        first = first if first is not None else float(loss)
+    # warmup transient over; steady state must be pure replay
+    engine.replay.replayed_steps = 0
+    engine.replay.fallbacks = 0
+    rounds = 4 * cfg.n_layers
+    d0 = engine.dispatch_count
+    *st, loss = step(*st, tok, tgt)
+    assert engine.replay.replayed_steps == rounds
+    assert engine.replay.fallbacks == 0
+    assert engine.dispatch_count - d0 == rounds, \
+        "each steady-state MoE exchange round must be ONE fused dispatch"
+    assert np.isfinite(float(loss))

@@ -291,6 +291,18 @@ def test_perf_smoke_busbw_sweep_one_band():
     assert r["busbw_topology"]["size"] == 8
 
 
+@pytest.mark.perf
+def test_perf_smoke_alltoall_busbw_one_band():
+    """ISSUE 17: the sweep's alltoall kind — (n-1)/n busbw convention,
+    measured-vs-roofline pair, and the per-band selected algorithm
+    resolved through the alltoall-specific knobs."""
+    from bench import bench_busbw
+    r = bench_busbw(sizes_bytes=[64 * 1024], iters=1)
+    assert "busbw_alltoall_64KB" in r and r["busbw_alltoall_64KB"] > 0
+    assert r["busbw_roofline_alltoall_64KB"] > 0
+    assert r["collective_algo_selected"]["alltoall_64KB"] in C.ALGORITHMS
+
+
 # ---------------------------------------------------------------------------
 # replay re-arms when selection knobs move
 # ---------------------------------------------------------------------------
