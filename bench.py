@@ -1252,6 +1252,152 @@ def bench_sp_ring():
     return out
 
 
+def bench_control_plane():
+    """Root KV control-plane load, direct vs hierarchical (ISSUE 18).
+
+    Two-slice np=4 fixture (local_size=2): four ranks each publish three
+    telemetry streams (a populated registry snapshot, a trace segment,
+    a stall heartbeat). Publishers fire at 2x the rollup cadence — the
+    real-default relationship (stall check_interval ~2s, agg interval
+    5s), so every rollup coalesces two publish cycles. Phase 1 sends
+    every publish straight to the root; phase 2 routes through per-slice
+    aggregators and the root only sees one rollup per stream per slice
+    per interval. Load is attributed with the root server's per-instance
+    ``request_stats()`` (the process-wide ``hvd_tpu_kv_requests_total``
+    would also count the aggregators' embedded receivers, which is
+    exactly the traffic the hierarchy is supposed to absorb)."""
+    from horovod_tpu.metrics import Registry
+    from horovod_tpu.runner.aggregator import SliceAggregator, TelemetryRoute
+    from horovod_tpu.runner.http_server import KVStoreServer
+    from horovod_tpu.runner.http_client import put_data_into_kvstore
+
+    local_size, n_slices = 2, 2
+    world = local_size * n_slices
+    intervals = 5
+    pubs_per_interval = 2
+    steps = intervals * pubs_per_interval
+    tele_scopes = ("metrics", "trace", "stall", "agg")
+
+    def _payloads(rank):
+        # a realistically-populated per-rank registry snapshot (the
+        # dominant telemetry stream), a sparse trace segment, and a
+        # stall heartbeat
+        reg = Registry()
+        reg.counter("hvd_tpu_steps_total", "steps").inc(100 + rank)
+        for i in range(24):
+            reg.counter("hvd_tpu_dispatches_total", "d").inc(
+                float(i), kind=("allreduce", "allgather", "alltoall",
+                                "broadcast")[i % 4])
+            reg.histogram("hvd_tpu_op_latency_seconds", "lat").observe(
+                0.001 * (i + 1))
+            reg.counter("hvd_tpu_bytes_reduced_total", "b").inc(1 << 20)
+        reg.gauge("hvd_tpu_elastic_world_version", "wv").inc(3)
+        metrics = json.dumps(reg.snapshot()).encode()
+        events = []
+        for i in range(12):
+            events.append({"p": "enq", "t": 0.5 + 0.01 * i,
+                           "c": f"{rank}:{i}", "k": "allreduce",
+                           "n": f"grad_{i}", "b": 1 << 18})
+            events.append({"p": "done", "t": 0.52 + 0.01 * i,
+                           "c": f"{rank}:{i}", "k": "allreduce",
+                           "n": f"grad_{i}", "b": 1 << 18})
+        trace = json.dumps({"schema": "hvd-tpu-trace-1", "rank": rank,
+                            "world_version": 1, "dropped": 0,
+                            "beacons": [[0.4, 1000.0, 0.001]],
+                            "events": events}).encode()
+        stall = json.dumps({"ts": 1000.0, "hb_step": 100 + rank,
+                            "hb_ts": 1000.0, "hb_idle": False,
+                            "replay_fallbacks": 0,
+                            "outstanding": []}).encode()
+        return {"metrics": metrics, "trace": trace, "stall": stall}
+
+    payloads = [_payloads(r) for r in range(world)]
+
+    def _delta(server, base):
+        reqs = bytes_ = 0
+        per_scope = {}
+        for (verb, scope), (n, nb) in server.request_stats().items():
+            if verb != "put" or scope not in tele_scopes:
+                continue
+            bn, bb = base.get((verb, scope), (0, 0))
+            if n - bn:
+                per_scope[scope] = {"requests": n - bn, "bytes": nb - bb}
+                reqs += n - bn
+                bytes_ += nb - bb
+        return reqs, bytes_, per_scope
+
+    # ---- phase 1: every rank publishes direct to the root -----------------
+    root = KVStoreServer(("127.0.0.1", 0))
+    port = root.start()
+    try:
+        base = root.request_stats()
+        for _ in range(intervals):
+            for _ in range(pubs_per_interval):
+                for r in range(world):
+                    for stream, body in payloads[r].items():
+                        put_data_into_kvstore(
+                            "127.0.0.1", port, stream, str(r), body,
+                            timeout=10)
+        d_reqs, d_bytes, d_scopes = _delta(root, base)
+    finally:
+        root.stop()
+
+    # ---- phase 2: per-slice aggregators, root sees rollups only -----------
+    def _hier(cardinality):
+        root = KVStoreServer(("127.0.0.1", 0))
+        port = root.start()
+        kv = ("127.0.0.1", port)
+        aggs, routes = [], []
+        try:
+            for k in range(n_slices):
+                a = SliceAggregator(
+                    kv, slice_index=k,
+                    ranks=list(range(k * local_size,
+                                     (k + 1) * local_size)),
+                    interval=3600.0, cardinality=cardinality,
+                    rank=k * local_size, advertise_host="127.0.0.1")
+                a.start()
+                aggs.append(a)
+            for r in range(world):
+                routes.append(TelemetryRoute.resolve(
+                    kv, r // local_size, timeout=5))
+            base = root.request_stats()
+            for _ in range(intervals):
+                for _ in range(pubs_per_interval):
+                    for r in range(world):
+                        for stream, body in payloads[r].items():
+                            routes[r].put(stream, stream, str(r), body,
+                                          timeout=10)
+                for a in aggs:
+                    a.rollup_once()
+            return _delta(root, base)
+        finally:
+            for a in aggs:
+                a.stop(final_rollup=False)
+            root.stop()
+
+    a_reqs, a_bytes, a_scopes = _hier("rank")
+    s_reqs, s_bytes, _ = _hier("slice")
+
+    return {
+        "cp_fixture": (f"np={world} two-slice (local_size={local_size}), "
+                       f"3 streams, {pubs_per_interval} publish cycles "
+                       f"per rollup interval, {intervals} intervals"),
+        "cp_root_requests_per_step_direct": round(d_reqs / steps, 2),
+        "cp_root_requests_per_step_agg": round(a_reqs / steps, 2),
+        "cp_root_requests_reduction": round(d_reqs / max(a_reqs, 1), 2),
+        "cp_root_bytes_per_step_direct": round(d_bytes / steps, 1),
+        "cp_root_bytes_per_step_agg": round(a_bytes / steps, 1),
+        "cp_root_bytes_reduction": round(d_bytes / max(a_bytes, 1), 2),
+        "cp_root_bytes_per_step_agg_slice_cardinality":
+            round(s_bytes / steps, 1),
+        "cp_root_bytes_reduction_slice_cardinality":
+            round(d_bytes / max(s_bytes, 1), 2),
+        "cp_root_put_breakdown_direct": d_scopes,
+        "cp_root_put_breakdown_agg": a_scopes,
+    }
+
+
 def main():
     import numpy as np
     import jax
@@ -1718,6 +1864,13 @@ def main():
     except Exception as e:
         provenance = {"provenance_error": f"{type(e).__name__}: {e}"}
 
+    # hierarchical telemetry: root control-plane load direct vs through
+    # the per-slice aggregator tier (ISSUE 18)
+    try:
+        cp = bench_control_plane()
+    except Exception as e:
+        cp = {"control_plane_error": f"{type(e).__name__}: {e}"}
+
     print(json.dumps({
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
         "value": round(img_s_chip, 2),
@@ -1745,6 +1898,7 @@ def main():
         **ckpt,
         **busbw,
         **provenance,
+        **cp,
         "spmd_spread_pct": round(spmd_spread, 1),
         "achieved_tflops_per_chip": round(tflops_chip, 2),
         "mfu_pct": (round(100.0 * tflops_chip / peak, 2)

@@ -261,6 +261,22 @@ HOROVOD_KV_LEASE_INTERVAL = "HOROVOD_KV_LEASE_INTERVAL"
 HOROVOD_KV_ACK_REPLICAS = "HOROVOD_KV_ACK_REPLICAS"
 HOROVOD_KV_JOURNAL_MAX = "HOROVOD_KV_JOURNAL_MAX"
 HOROVOD_KV_SCOPE_BUDGET_BYTES = "HOROVOD_KV_SCOPE_BUDGET_BYTES"
+# hierarchical telemetry fabric (ISSUE 18, runner/aggregator.py): AGG_ENABLE
+# turns on the per-slice aggregator tier — each slice's lowest-rank worker
+# hosts a SliceAggregator that receives slice-local metrics/trace/stall
+# publishes and rolls ONE merged payload per stream per AGG_INTERVAL to the
+# replicated root (O(slices) root load instead of O(ranks)); only effective
+# when the topology factorizes (1 < local_size < size). AGG_CARDINALITY
+# picks the metrics rollup shape: "rank" preserves per-rank snapshots inside
+# the rollup, "slice" pre-sums them to one synthetic slice<k> series set.
+# AGG_FALLBACK governs what a publisher does when its aggregator is dead:
+# =1 (default) degrades loudly to direct-to-root (counted in
+# hvd_tpu_agg_fallback_total), =0 raises to the caller. All resolved once
+# at init (divcheck) — the elastic driver re-hosts aggregators per world.
+HOROVOD_TPU_AGG_ENABLE = "HOROVOD_TPU_AGG_ENABLE"
+HOROVOD_TPU_AGG_INTERVAL = "HOROVOD_TPU_AGG_INTERVAL"
+HOROVOD_TPU_AGG_CARDINALITY = "HOROVOD_TPU_AGG_CARDINALITY"
+HOROVOD_TPU_AGG_FALLBACK = "HOROVOD_TPU_AGG_FALLBACK"
 HOROVOD_TPU_CHECKPOINT_INTERVAL_STEPS = "HOROVOD_TPU_CHECKPOINT_INTERVAL_STEPS"
 HOROVOD_TPU_CHECKPOINT_REDUNDANCY = "HOROVOD_TPU_CHECKPOINT_REDUNDANCY"
 HOROVOD_TPU_CHECKPOINT_KEEP = "HOROVOD_TPU_CHECKPOINT_KEEP"
@@ -277,6 +293,7 @@ COLLECTIVE_ALGO_MODES = ("auto", "flat", "tree", "hierarchical")
 ALLTOALL_ALGO_MODES = ("auto", "flat", "hierarchical")
 COMPRESSION_MODES = ("none", "bf16", "fp8", "int8")
 PIPELINE_SCHEDULE_MODES = ("1f1b", "interleaved", "zb", "auto")
+AGG_CARDINALITY_MODES = ("rank", "slice")
 _XLA_LHS_FLAG = "--xla_tpu_enable_latency_hiding_scheduler=true"
 
 
@@ -445,6 +462,10 @@ class Config:
     trace_ring: int = 4096
     trace_interval: float = 5.0
     trace_dump_dir: Optional[str] = None
+    agg_enable: bool = True
+    agg_interval: float = 5.0
+    agg_cardinality: str = "rank"
+    agg_fallback: bool = True
     checkpoint_dir: Optional[str] = None
     checkpoint_interval_steps: int = 0
     checkpoint_redundancy: int = 1
@@ -562,6 +583,11 @@ class Config:
             trace_ring=_get_int(HOROVOD_TPU_TRACE_RING, 4096),
             trace_interval=_get_float(HOROVOD_TPU_TRACE_INTERVAL, 5.0),
             trace_dump_dir=os.environ.get(HOROVOD_TPU_TRACE_DUMP_DIR) or None,
+            agg_enable=_get_bool(HOROVOD_TPU_AGG_ENABLE, True),
+            agg_interval=_get_float(HOROVOD_TPU_AGG_INTERVAL, 5.0),
+            agg_cardinality=_get_choice(
+                HOROVOD_TPU_AGG_CARDINALITY, "rank", AGG_CARDINALITY_MODES),
+            agg_fallback=_get_bool(HOROVOD_TPU_AGG_FALLBACK, True),
             checkpoint_dir=os.environ.get(HOROVOD_TPU_CHECKPOINT_DIR)
             or None,
             checkpoint_interval_steps=_get_int(

@@ -391,6 +391,31 @@ KNOB_SPECS: Dict[str, dict] = {
         "type": "str", "default": "",
         "help": "Directory for the watchdog's flight-recorder trace dump "
                 "(hvd_tpu_flight_rank<r>.json)."},
+    # -- hierarchical telemetry ---------------------------------------------
+    "HOROVOD_TPU_AGG_ENABLE": {
+        "type": "bool", "default": "1",
+        "help": "Per-slice telemetry aggregators: each slice's lowest "
+                "rank hosts a SliceAggregator that pre-merges the "
+                "slice's metrics/trace/stall publishes and rolls one "
+                "payload per stream per interval to the root (O(slices) "
+                "root load); no-op on flat topologies."},
+    "HOROVOD_TPU_AGG_INTERVAL": {
+        "type": "float", "default": "5.0",
+        "help": "Seconds between a slice aggregator's rollup pushes to "
+                "the root KV."},
+    "HOROVOD_TPU_AGG_CARDINALITY": {
+        "type": "choice", "default": "rank",
+        "choices": ("rank", "slice"),
+        "help": "Metrics rollup shape: 'rank' preserves per-rank "
+                "snapshots inside the slice rollup; 'slice' pre-sums "
+                "them into one synthetic slice<k> series set (cheaper "
+                "root scrape, loses rank attribution)."},
+    "HOROVOD_TPU_AGG_FALLBACK": {
+        "type": "bool", "default": "1",
+        "help": "When a slice aggregator is unreachable, publishers "
+                "degrade to direct-to-root (counted in "
+                "hvd_tpu_agg_fallback_total, WARNING on first flip); "
+                "=0 raises the publish error to the caller instead."},
     # -- timeline -----------------------------------------------------------
     "HOROVOD_TIMELINE": {
         "type": "str", "default": "",

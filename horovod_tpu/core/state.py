@@ -25,6 +25,8 @@ class GlobalState:
         self.trace_recorder = None
         self.trace_publisher = None
         self.checkpoint_manager = None
+        self.slice_aggregator = None
+        self.telemetry_route = None
 
     def init(self):
         with self._lock:
@@ -56,6 +58,50 @@ class GlobalState:
             kv = (resolve_endpoints(kv_spec), None)
         elif rdv_addr and rdv_port:
             kv = (rdv_addr, int(rdv_port))
+        # Hierarchical telemetry fabric (ISSUE 18, runner/aggregator.py):
+        # when the topology factorizes into slices, each slice's lowest
+        # rank hosts a SliceAggregator and every rank routes its metrics/
+        # trace/stall publishes through a TelemetryRoute that targets it —
+        # the root then sees O(slices) rollup writes per interval instead
+        # of O(ranks) raw publishes. Flat topologies (and kv-less runs)
+        # skip the tier entirely: route stays None and every publisher
+        # below keeps its direct-to-root path. Resolved ONCE here, at
+        # init; the elastic driver clears the "agg" scope on world resets
+        # so re-inits re-host and re-resolve against the new world.
+        route = None
+        topo = self.engine.topology
+        if (kv is not None and cfg.agg_enable
+                and getattr(topo, "hierarchical_ok", False)):
+            from ..runner.aggregator import SliceAggregator, TelemetryRoute
+            rank = self.backend.rank()
+            slice_idx = rank // topo.local_size
+            if rank % topo.local_size == 0:
+                ranks = list(range(slice_idx * topo.local_size,
+                                   (slice_idx + 1) * topo.local_size))
+                agg = SliceAggregator(
+                    kv, slice_index=slice_idx, ranks=ranks,
+                    interval=cfg.agg_interval,
+                    cardinality=cfg.agg_cardinality, rank=rank)
+                try:
+                    addr = agg.start()
+                    self.slice_aggregator = agg
+                    # the host shortcuts its own route — no KV long-poll
+                    # for a registration it just wrote
+                    route = TelemetryRoute(kv, slice_index=slice_idx,
+                                           agg_addr=addr,
+                                           fallback=cfg.agg_fallback)
+                except Exception as e:  # errflow: ignore[aggregator start failure degrades this slice to direct-to-root telemetry (WARNING below); init must never die for the telemetry tier]
+                    import logging
+                    logging.getLogger("horovod_tpu").warning(
+                        "slice %d aggregator failed to start (%s); "
+                        "telemetry publishes go direct to the root",
+                        slice_idx, e)
+                    self.slice_aggregator = None
+            else:
+                route = TelemetryRoute.resolve(
+                    kv, slice_idx, fallback=cfg.agg_fallback,
+                    timeout=10.0)
+            self.telemetry_route = route
         if cfg.timeline_path:
             from ..timeline import Timeline
             # every rank records its own local timeline (pid = rank, so
@@ -82,7 +128,7 @@ class GlobalState:
             if kv is not None:
                 self.trace_publisher = TracePublisher(
                     self.trace_recorder, kv, rank=self.backend.rank(),
-                    interval=cfg.trace_interval)
+                    interval=cfg.trace_interval, route=route)
                 self.trace_publisher.start()
         if not cfg.stall_check_disable or cfg.collective_deadline > 0:
             from ..stall_inspector import StallInspector
@@ -124,7 +170,9 @@ class GlobalState:
                                   else cfg.stall_shutdown_seconds),
                 kv=kv, rank=self.backend.rank(), size=self.backend.size(),
                 collective_deadline=cfg.collective_deadline,
-                escalate=_escalate, flight_dump=_flight_dump)
+                escalate=_escalate, flight_dump=_flight_dump,
+                route=route, topology=topo,
+                agg_interval=cfg.agg_interval)
         # async sharded checkpointing (ISSUE 9, horovod_tpu/checkpoint/):
         # the durable tier above the in-memory elastic commit. Rebuilt on
         # every (re-)init so rank/size/world_version track the live world;
@@ -154,7 +202,8 @@ class GlobalState:
             self.metrics_emitter = MetricsEmitter(
                 reg, interval=cfg.metrics_interval,
                 jsonl_path=cfg.metrics_file, kv=kv,
-                rank=self.backend.rank(), timeline=self.timeline)
+                rank=self.backend.rank(), timeline=self.timeline,
+                route=route)
             self.metrics_emitter.start()
 
         if cfg.autotune:
@@ -378,6 +427,14 @@ class GlobalState:
             if self.stall_inspector is not None:
                 self.stall_inspector.stop()
                 self.stall_inspector = None
+            if self.slice_aggregator is not None:
+                # after every publisher stopped (their final flushes may
+                # still route through the aggregator), before the backend
+                # goes away; the final rollup ships whatever landed since
+                # the last interval so short-lived jobs still merge
+                self.slice_aggregator.stop(final_rollup=True)
+                self.slice_aggregator = None
+            self.telemetry_route = None
             if self.parameter_manager is not None:
                 self.parameter_manager.close()
                 self.parameter_manager = None

@@ -316,6 +316,12 @@ class ElasticDriver:
             # distinguishable)
             if hasattr(self._rendezvous, "clear_scope"):
                 self._rendezvous.clear_scope("trace")
+                # stale aggregator registrations and rollups likewise
+                # belong to the old rank numbering; dropping the scope
+                # forces re-hosting workers to re-register and peers'
+                # TelemetryRoute.resolve to wait for the NEW world's
+                # aggregator instead of latching a dead address
+                self._rendezvous.clear_scope("agg")
             self._registry.reset(
                 [f"{s.hostname}:{s.local_rank}" for s in assignments])
             pending = [s for s in assignments

@@ -152,6 +152,14 @@ FAULT_SPECS: Dict[str, str] = {
                      "(trace.publish_segment); drop() models a silently "
                      "lost segment — the merged /trace must degrade "
                      "gracefully, never fail",
+    # runner/aggregator.py (ISSUE 18 hierarchical telemetry)
+    "agg.rollup": "At the top of a slice aggregator's rollup pass; "
+                  "drop() skips the whole interval (stale rollups at "
+                  "the root — the stall sweep's staleness fallback "
+                  "must kick in), hang() models a wedged aggregator",
+    "agg.publish": "Before each per-stream rollup push to the root KV; "
+                   "drop() silently loses that stream's rollup for the "
+                   "interval while the others land",
 }
 
 

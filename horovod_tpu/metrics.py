@@ -265,6 +265,32 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
     "hvd_tpu_link_gbps": (
         "gauge", "Per-fabric link bandwidth the selection layer is using, "
                  "by link (ici/dcn) and source (nominal/measured)"),
+    # runner/aggregator.py (ISSUE 18 per-slice telemetry aggregation)
+    "hvd_tpu_agg_rollups_total": (
+        "counter", "Pre-merged telemetry rollups published by this slice "
+                   "aggregator to the root KV, by stream "
+                   "(metrics/trace/stall) — ONE per stream per interval, "
+                   "so root request load is O(slices)"),
+    "hvd_tpu_agg_merged_ranks_total": (
+        "counter", "Per-rank telemetry payloads folded into rollups by "
+                   "this slice aggregator, by stream"),
+    "hvd_tpu_agg_bytes_total": (
+        "counter", "Rollup payload bytes shipped to the root KV by this "
+                   "slice aggregator, by stream"),
+    "hvd_tpu_agg_fallback_total": (
+        "counter", "Telemetry publishes that fell back DIRECT to the root "
+                   "KV because the slice aggregator was unreachable or "
+                   "its circuit breaker open, by stream — a dead "
+                   "aggregator degrades the hierarchy, never blinds it"),
+    # runner/http_server.py (ISSUE 18: root load measured, not inferred)
+    "hvd_tpu_kv_requests_total": (
+        "counter", "KV/rendezvous HTTP requests served by this server, by "
+                   "verb (get/put/delete) and scope — the O(ranks) vs "
+                   "O(slices) control-plane load claim, measured server-"
+                   "side"),
+    "hvd_tpu_kv_request_bytes_total": (
+        "counter", "Request payload bytes received by this KV server "
+                   "(PUT bodies), by verb and scope"),
 }
 
 
@@ -653,11 +679,13 @@ def render_prometheus_cluster(snaps: Dict[str, dict]) -> str:
 # ---------------------------------------------------------------------------
 
 def publish_snapshot(kv: Tuple[str, int], rank: int, snap: dict,
-                     timeout: float = 5.0):
+                     timeout: float = 5.0, route=None):
     """PUT one snapshot to the rendezvous KV under ``metrics/<rank>`` (the
     ``stall/<rank>`` pattern); the server's ``GET /metrics`` aggregates
     them. Shared by the MetricsEmitter and by tests that need a
-    deterministic publish."""
+    deterministic publish. With a ``route`` (:class:`..runner.aggregator.
+    TelemetryRoute`), the publish rides the slice aggregator tier instead
+    of going direct to the root — same key, same backpressure contract."""
     from .faults import DROP, failpoint
     from .runner.http_client import (KVBackpressure, count_shed_bytes,
                                      put_data_into_kvstore)
@@ -665,8 +693,12 @@ def publish_snapshot(kv: Tuple[str, int], rank: int, snap: dict,
         return
     payload = json.dumps(snap).encode()
     try:
-        put_data_into_kvstore(kv[0], kv[1], METRICS_KV_SCOPE, str(rank),
-                              payload, timeout=timeout)
+        if route is not None:
+            route.put("metrics", METRICS_KV_SCOPE, str(rank), payload,
+                      timeout=timeout)
+        else:
+            put_data_into_kvstore(kv[0], kv[1], METRICS_KV_SCOPE, str(rank),
+                                  payload, timeout=timeout)
     except KVBackpressure:
         # server asked for shedding (scope byte budget): drop this
         # snapshot — the next tick's supersedes it anyway (last-writer-
@@ -700,7 +732,7 @@ class MetricsEmitter(threading.Thread):
     def __init__(self, reg: Registry, interval: float = 10.0,
                  jsonl_path: Optional[str] = None,
                  kv: Optional[Tuple[str, int]] = None, rank: int = 0,
-                 timeline=None):
+                 timeline=None, route=None):
         super().__init__(name="hvd-metrics", daemon=True)
         self.reg = reg
         self.interval = max(float(interval), 0.05)
@@ -708,6 +740,7 @@ class MetricsEmitter(threading.Thread):
         self.kv = kv
         self.rank = rank
         self.timeline = timeline
+        self.route = route
         # NOT named _stop: Thread.join() calls an internal _stop()
         self._stop_evt = threading.Event()
         self._prev: Optional[Tuple[float, float, float]] = None
@@ -740,7 +773,8 @@ class MetricsEmitter(threading.Thread):
                 log.debug("metrics JSONL write failed: %s", e)
         if self.kv is not None:
             try:
-                publish_snapshot(self.kv, self.rank, snap)
+                publish_snapshot(self.kv, self.rank, snap,
+                                 route=self.route)
             except Exception as e:
                 log.debug("metrics KV publish failed: %s", e)
         if self.timeline is not None:

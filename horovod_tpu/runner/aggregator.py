@@ -1,0 +1,536 @@
+"""Per-slice telemetry aggregation tier (ISSUE 18, ROADMAP item 3).
+
+Every observability stream since PR 3 — ``metrics/<rank>``,
+``trace/<rank>``, ``stall/<rank>`` — published directly to the (replicated,
+PR 12) KV root, so root load was O(ranks) per publish interval. This module
+mirrors the data plane's ICI/DCN hierarchy (PR 10/17) in the control plane:
+
+- :class:`SliceAggregator` — one per slice, hosted on the slice's
+  lowest-rank worker. It embeds its own :class:`..runner.http_server.
+  KVStoreServer` as the ICI-local receiver: slice peers publish their
+  ``metrics``/``trace``/``stall`` payloads to it with the ordinary KV
+  client, and a background thread pre-merges them and rolls ONE payload
+  per stream per interval up to the root under ``agg/<stream>/<slice>``
+  — root requests and bytes are O(slices), not O(ranks).
+
+  Pre-merges performed at the edge:
+
+  * **metrics** — per-rank snapshots forwarded intact (``cardinality=
+    "rank"``: the root scrape reconciles exactly with per-rank snapshots)
+    or summed into one per-slice snapshot (``cardinality="slice"``:
+    counters/histograms summed, gauges per-series max, event logs reduced
+    to their counts) behind ``HOROVOD_TPU_AGG_CARDINALITY``.
+  * **trace** — segments are clock-aligned at the edge with the PR 5
+    beacon machinery: each worker beacons against the *aggregator's*
+    clock, the aggregator maps its own wall clock onto the root's
+    (min-rtt ``fetch_server_clock`` pairing), and every event timestamp
+    is rewritten into root wall time. The forwarded segment carries the
+    identity beacon ``[[0.0, 0.0, 1e-6]]`` so the root merger's
+    ``clock_offset`` resolves to 0 and treats it as aligned; ``pid`` is
+    pinned to the rank. Beacon-less segments pass through untouched and
+    stay ``(unaligned)`` — degraded, never dropped.
+  * **stall** — per-rank liveness scalars kept lossless, outstanding
+    tensor names deduplicated into one ``name -> [ranks]`` map (the
+    per-slice missing-rank set); rank 0's sweep reconstructs per-rank
+    reports from O(slices) keys (:meth:`..stall_inspector.StallInspector.
+    _read_reports`).
+
+- :class:`TelemetryRoute` — the one routing decision every publisher
+  (metrics emitter, trace publisher, stall inspector) shares: resolved
+  ONCE at init (divcheck's endpoint-resolution discipline) from the
+  ``agg/<slice>`` KV registration, one-shot publishes to the slice
+  aggregator with a loud per-stream fallback to direct-to-root when the
+  aggregator is dead (circuit breaker on the PR 12 :class:`..runner.
+  http_client.Endpoints`, ``hvd_tpu_agg_fallback_total{stream}``
+  counted). A killed aggregator degrades the hierarchy, never blinds it;
+  the elastic driver clears the ``agg`` scope on world activation and the
+  re-init re-hosts the aggregator.
+
+Fault injection: ``agg.rollup`` (a skipped merge tick) and ``agg.publish``
+(a silently-lost rollup) ride :data:`..faults.FAULT_SPECS` so the chaos
+suite can exercise the degradation paths deterministically.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..faults import DROP, failpoint
+from ..metrics import registry as metrics_registry
+
+logger = logging.getLogger("horovod_tpu.runner")
+
+# KV scope carrying aggregator registrations (key "<slice>") and rollups
+# (keys "<stream>/<slice>") — == http_server.AGG_SCOPE, kept literal there
+# so the server module stays importable standalone.
+AGG_KV_SCOPE = "agg"
+
+# the three telemetry streams the tier aggregates; each maps onto the
+# worker-publish KV scope of the same name
+AGG_STREAMS = ("metrics", "trace", "stall")
+
+# identity beacon stamped on edge-aligned trace segments: the root
+# merger's clock_offset() resolves it to 0.0, so timestamps already in
+# root wall time pass through unshifted and the rank renders as aligned
+_IDENTITY_BEACON = [[0.0, 0.0, 1e-6]]
+
+
+def _default_advertise_host() -> str:
+    """Best-effort reachable address for the embedded receiver (the
+    aggregator binds 0.0.0.0; slice peers connect over the ICI-local
+    network). No env read — knobcheck keeps the env plane declared."""
+    try:
+        host = socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+    return host or "127.0.0.1"
+
+
+def _sum_snapshots(snaps: List[dict]) -> dict:
+    """Merge per-rank registry snapshots into ONE per-slice snapshot
+    (``cardinality="slice"``): counters and histograms sum per label set,
+    gauges take the per-series max (summing a world-version gauge would
+    be nonsense), event logs reduce to their per-kind counts. Bucket
+    bounds are identical across ranks (same code), so histogram
+    cumulative counts merge positionally by ``le``."""
+    out = {"enabled": True, "counters": {}, "gauges": {},
+           "histograms": {}, "events": {}}
+
+    def _acc(section, name, help_):
+        return out[section].setdefault(
+            name, {"help": help_, "_acc": {}})["_acc"]
+
+    for snap in snaps:
+        for name, ent in snap.get("counters", {}).items():
+            acc = _acc("counters", name, ent.get("help", ""))
+            for labels, v in ent.get("values", []):
+                k = tuple(sorted(labels.items()))
+                acc[k] = acc.get(k, 0.0) + float(v)
+        for name, ent in snap.get("gauges", {}).items():
+            acc = _acc("gauges", name, ent.get("help", ""))
+            for labels, v in ent.get("values", []):
+                k = tuple(sorted(labels.items()))
+                acc[k] = max(acc.get(k, float("-inf")), float(v))
+        for name, ent in snap.get("histograms", {}).items():
+            acc = _acc("histograms", name, ent.get("help", ""))
+            for labels, h in ent.get("values", []):
+                k = tuple(sorted(labels.items()))
+                cur = acc.get(k)
+                if cur is None:
+                    acc[k] = {"sum": float(h.get("sum", 0.0)),
+                              "count": int(h.get("count", 0)),
+                              "buckets": {le: c for le, c
+                                          in h.get("buckets", [])}}
+                else:
+                    cur["sum"] += float(h.get("sum", 0.0))
+                    cur["count"] += int(h.get("count", 0))
+                    for le, c in h.get("buckets", []):
+                        cur["buckets"][le] = cur["buckets"].get(le, 0) + c
+        for name, ent in snap.get("events", {}).items():
+            acc = _acc("events", name, ent.get("help", ""))
+            vals = ent.get("values")
+            counts = vals.get("counts", []) if isinstance(vals, dict) else []
+            for labels, v in counts:
+                k = tuple(sorted(labels.items()))
+                acc[k] = acc.get(k, 0.0) + float(v)
+
+    for section in ("counters", "gauges"):
+        for name, ent in out[section].items():
+            ent["values"] = [[dict(k), v]
+                             for k, v in ent.pop("_acc").items()]
+    for name, ent in out["histograms"].items():
+        values = []
+        for k, h in ent.pop("_acc").items():
+            values.append([dict(k), {"sum": h["sum"], "count": h["count"],
+                                     "buckets": [[le, c] for le, c
+                                                 in h["buckets"].items()]}])
+        ent["values"] = values
+    for name, ent in out["events"].items():
+        # per-slice event cardinality: counts survive the merge, the raw
+        # logs do not (they are per-rank artifacts; the JSONL sink keeps
+        # them locally)
+        ent["values"] = {"counts": [[dict(k), v] for k, v
+                                    in ent.pop("_acc").items()],
+                         "log": []}
+    return out
+
+
+class SliceAggregator:
+    """One slice's telemetry aggregation service. Owns an embedded
+    :class:`..runner.http_server.KVStoreServer` (the ICI-local receiver),
+    registers its address in the root KV under ``agg/<slice>``, and rolls
+    one pre-merged payload per stream per interval up to the root under
+    ``agg/<stream>/<slice>``.
+
+    Observable: ``hvd_tpu_agg_rollups_total{stream}`` (rollup PUTs),
+    ``hvd_tpu_agg_merged_ranks_total{stream}`` (rank payloads folded into
+    rollups), ``hvd_tpu_agg_bytes_total{stream}`` (rollup bytes shipped);
+    root backpressure on a rollup sheds like any telemetry publisher
+    (``hvd_tpu_kv_shed_bytes_total{scope="agg"}``)."""
+
+    # lock discipline (tools/check.py lockcheck): the rollup thread
+    # refreshes the root clock delta and the per-stream rollup stamps
+    # while status()/tests read them.
+    _GUARDED_BY = {
+        "_root_delta": "_lock",
+        "_last_rollup": "_lock",
+    }
+
+    def __init__(self, root_kv, slice_index: int, ranks,
+                 interval: float = 5.0, cardinality: str = "rank",
+                 rank: Optional[int] = None,
+                 advertise_host: Optional[str] = None):
+        from .http_server import KVStoreServer
+        self.root_kv = root_kv
+        self.slice_index = int(slice_index)
+        self.ranks = [int(r) for r in ranks]
+        self.interval = max(float(interval), 0.05)
+        self.cardinality = cardinality
+        self.rank = rank
+        self.server = KVStoreServer(("0.0.0.0", 0))
+        self.addr: Optional[Tuple[str, int]] = None
+        self._advertise_host = advertise_host or _default_advertise_host()
+        self._lock = threading.Lock()
+        self._root_delta = 0.0           # aggregator wall -> root wall
+        self._last_rollup: Dict[str, float] = {}
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        reg = metrics_registry()
+        self._m_rollups = reg.counter("hvd_tpu_agg_rollups_total")
+        self._m_merged = reg.counter("hvd_tpu_agg_merged_ranks_total")
+        self._m_bytes = reg.counter("hvd_tpu_agg_bytes_total")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> Tuple[str, int]:
+        """Start the receiver, register ``agg/<slice>`` in the root KV
+        (slice peers long-poll this key at route resolution), and begin
+        the rollup thread. Returns the advertised ``(host, port)``."""
+        from .http_client import put_data_into_kvstore
+        port = self.server.start()
+        self.addr = (self._advertise_host, port)
+        self._refresh_root_delta()
+        reg_payload = json.dumps({
+            "addr": f"{self.addr[0]}:{self.addr[1]}",
+            "slice": self.slice_index,
+            "ranks": self.ranks,
+            "rank": self.rank,
+            "ts": time.time()}).encode()
+        put_data_into_kvstore(self.root_kv[0], self.root_kv[1],
+                              AGG_KV_SCOPE, str(self.slice_index),
+                              reg_payload, timeout=10, retries=1)
+        self._thread = threading.Thread(target=self._run,
+                                        name="hvd-agg", daemon=True)
+        self._thread.start()
+        logger.info("slice %d aggregator serving %s on %s:%d (ranks %s)",
+                    self.slice_index, "/".join(AGG_STREAMS),
+                    self.addr[0], self.addr[1], self.ranks)
+        return self.addr
+
+    def _run(self):
+        while not self._stop_evt.wait(self.interval):
+            try:
+                self.rollup_once()
+            except Exception as e:
+                # periodic best-effort: the next interval retries; a tick
+                # failure must never kill the hosting worker
+                logger.debug("slice %d rollup tick failed: %s",
+                             self.slice_index, e)
+
+    def stop(self, final_rollup: bool = True):
+        """Stop the rollup thread, ship one final rollup (short-lived jobs
+        still appear in the root scrape/trace), then stop the receiver."""
+        self._stop_evt.set()
+        if self._thread is not None and self._thread.is_alive() and \
+                threading.current_thread() is not self._thread:
+            self._thread.join(timeout=10)
+        if final_rollup:
+            try:
+                self.rollup_once()
+            except Exception as e:
+                # best-effort: the root may already be gone at teardown;
+                # the receiver below must still stop
+                logger.debug("slice %d final rollup failed: %s",
+                             self.slice_index, e)
+        self.server.stop()
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"slice": self.slice_index, "addr": self.addr,
+                    "ranks": self.ranks,
+                    "root_delta": self._root_delta,
+                    "last_rollup": dict(self._last_rollup)}
+
+    # -- clock --------------------------------------------------------------
+
+    def _refresh_root_delta(self):
+        """Map this host's wall clock onto the root's: one
+        ``fetch_server_clock`` beacon bracketed by local ``time.time()``
+        samples (midpoint pairing, rtt-bounded error — the trace.py
+        discipline applied one tier up). Keeps the previous delta on
+        failure."""
+        from .http_client import fetch_server_clock
+        try:
+            t0 = time.time()
+            _mono, server_ts, _rtt = fetch_server_clock(
+                self.root_kv[0], self.root_kv[1], timeout=5.0)
+            t1 = time.time()
+        except Exception as e:
+            logger.debug("slice %d root clock beacon failed: %s",
+                         self.slice_index, e)
+            return
+        with self._lock:
+            self._root_delta = server_ts - (t0 + t1) / 2.0
+
+    # -- rollup -------------------------------------------------------------
+
+    def rollup_once(self):
+        """One merge-and-publish pass over every stream. Public so tests
+        and the bench drive rollups deterministically instead of waiting
+        out the interval."""
+        from .http_client import (KVBackpressure, count_shed_bytes,
+                                  put_data_into_kvstore)
+        if failpoint("agg.rollup") is DROP:
+            return
+        self._refresh_root_delta()
+        for stream, build in (("metrics", self._build_metrics),
+                              ("trace", self._build_trace),
+                              ("stall", self._build_stall)):
+            payload, merged = build()
+            if payload is None:
+                continue
+            blob = json.dumps(payload).encode()
+            if failpoint("agg.publish") is DROP:
+                continue
+            try:
+                put_data_into_kvstore(
+                    self.root_kv[0], self.root_kv[1], AGG_KV_SCOPE,
+                    f"{stream}/{self.slice_index}", blob, timeout=5,
+                    retries=1)
+            except KVBackpressure:
+                # root asked for shedding: the rollup is last-writer-wins,
+                # the next interval's supersedes it — count, never block
+                count_shed_bytes(AGG_KV_SCOPE, len(blob))
+                continue
+            except Exception as e:
+                # one interval of one stream degrades; the publishers'
+                # own fallback path keeps the root fed if the outage
+                # persists
+                logger.debug("slice %d %s rollup publish failed: %s",
+                             self.slice_index, stream, e)
+                continue
+            self._m_rollups.inc(stream=stream)
+            self._m_merged.inc(merged, stream=stream)
+            self._m_bytes.inc(len(blob), stream=stream)
+            with self._lock:
+                self._last_rollup[stream] = time.time()
+
+    def _payloads(self, scope: str) -> Dict[str, bytes]:
+        return self.server.snapshot(scope).get(scope, {})
+
+    def _build_metrics(self):
+        parsed: Dict[str, dict] = {}
+        for key, raw in self._payloads("metrics").items():
+            try:
+                parsed[str(key)] = json.loads(raw)
+            except Exception:
+                logger.debug("slice %d: unparseable metrics payload from "
+                             "%r", self.slice_index, key)
+        if not parsed:
+            return None, 0
+        if self.cardinality == "slice":
+            snaps = {f"slice{self.slice_index}":
+                     _sum_snapshots(list(parsed.values()))}
+        else:
+            snaps = parsed
+        return ({"slice": self.slice_index, "mode": self.cardinality,
+                 "ts": time.time(), "snaps": snaps}, len(parsed))
+
+    def _build_trace(self):
+        with self._lock:
+            delta = self._root_delta
+        segments: Dict[str, dict] = {}
+        for key, raw in self._payloads("trace").items():
+            try:
+                from ..trace import clock_offset
+                seg = json.loads(raw)
+                if not isinstance(seg, dict) or "events" not in seg:
+                    raise ValueError("not a trace segment")
+                rank = int(seg.get("rank", key))
+                off = clock_offset(seg.get("beacons"))
+                if off is not None:
+                    # edge alignment: worker monotonic -> aggregator wall
+                    # (worker beacons target THIS server) -> root wall
+                    shift = off + delta
+                    for ev in seg.get("events", ()):
+                        t = ev.get("t")
+                        if isinstance(t, (int, float)):
+                            ev["t"] = t + shift
+                    seg["beacons"] = [list(b) for b in _IDENTITY_BEACON]
+                seg["rank"] = rank
+                segments[str(rank)] = seg
+            except Exception as e:
+                logger.debug("slice %d: unusable trace payload from %r: "
+                             "%s", self.slice_index, key, e)
+        if not segments:
+            return None, 0
+        return ({"slice": self.slice_index, "ts": time.time(),
+                 "segments": segments}, len(segments))
+
+    def _build_stall(self):
+        reports: Dict[str, dict] = {}
+        outstanding: Dict[str, List[int]] = {}
+        for key, raw in self._payloads("stall").items():
+            try:
+                rep = json.loads(raw)
+                r = int(key)
+            except Exception:
+                logger.debug("slice %d: unparseable stall payload from %r",
+                             self.slice_index, key)
+                continue
+            reports[str(r)] = {k: rep[k] for k in
+                               ("ts", "hb_step", "hb_ts", "hb_idle",
+                                "replay_fallbacks") if k in rep}
+            for name in rep.get("outstanding", ()):
+                outstanding.setdefault(str(name), []).append(r)
+        if not reports:
+            return None, 0
+        return ({"slice": self.slice_index,
+                 "ts": max(rep.get("ts", 0.0) for rep in reports.values()),
+                 "reports": reports,
+                 "outstanding": {n: sorted(rs)
+                                 for n, rs in outstanding.items()}},
+                len(reports))
+
+
+class TelemetryRoute:
+    """The shared publisher routing decision: rank -> its slice
+    aggregator, with loud per-stream fallback to direct-to-root.
+
+    Resolved ONCE at init (:meth:`resolve` long-polls the ``agg/<slice>``
+    registration); publishers then call :meth:`put` per tick. The
+    aggregator attempt is a true one-shot (``retries=0``) guarded by the
+    endpoint's circuit breaker — while the breaker is open the attempt is
+    skipped entirely, so a dead aggregator costs its slice at most
+    ``HOROVOD_KV_BREAKER_FAILURES`` failed publishes before every
+    publisher goes direct (and the half-open probe re-adopts it when it
+    returns). Every direct-to-root publish while an aggregator is
+    configured counts ``hvd_tpu_agg_fallback_total{stream}``; the first
+    per stream is a WARNING, later ones debug. ``KVBackpressure``
+    propagates untouched — shedding stays the publisher's decision."""
+
+    _GUARDED_BY = {"_warned": "_lock"}
+
+    def __init__(self, kv, slice_index: int = 0,
+                 agg_addr: Optional[Tuple[str, int]] = None,
+                 fallback: bool = True):
+        from .http_client import resolve_endpoints
+        self.kv = kv
+        self.slice_index = int(slice_index)
+        self.fallback = bool(fallback)
+        self.agg = (resolve_endpoints(agg_addr[0], agg_addr[1])
+                    if agg_addr is not None else None)
+        self._lock = threading.Lock()
+        self._warned: set = set()
+        self._m_fallback = metrics_registry().counter(
+            "hvd_tpu_agg_fallback_total")
+
+    @classmethod
+    def resolve(cls, kv, slice_index: int, fallback: bool = True,
+                timeout: float = 10.0) -> "TelemetryRoute":
+        """Long-poll the ``agg/<slice>`` registration from the root KV
+        and build the route. A missing registration (no aggregator came
+        up for this slice) degrades to a direct-to-root route with a loud
+        WARNING — never a failed init."""
+        from .http_client import read_data_from_kvstore
+        try:
+            raw = read_data_from_kvstore(kv[0], kv[1], AGG_KV_SCOPE,
+                                         str(slice_index), timeout=timeout,
+                                         poll_interval=0.2)
+            info = json.loads(raw)
+            host, _, port_s = str(info["addr"]).rpartition(":")
+            return cls(kv, slice_index, (host, int(port_s)),
+                       fallback=fallback)
+        except Exception as e:
+            logger.warning(
+                "slice %d: no aggregator registration within %.0fs (%s); "
+                "telemetry publishes go direct to the root KV — root load "
+                "for this slice stays O(ranks).", slice_index, timeout, e)
+            return cls(kv, slice_index, None, fallback=fallback)
+
+    @property
+    def hierarchical(self) -> bool:
+        return self.agg is not None
+
+    def clock_target(self):
+        """The KV handle trace beacons should pair against — the
+        aggregator while it is healthy (edge alignment maps worker
+        monotonic onto the AGGREGATOR clock), the root otherwise. The
+        trace publisher resets its beacon window when this flips."""
+        if self.agg is not None and not self.agg.tripped():
+            return (self.agg, None)
+        return self.kv
+
+    def put(self, stream: str, scope: str, key: str, value,
+            timeout: float = 5.0):
+        """Publish one payload: aggregator first (one-shot, breaker-
+        gated), direct-to-root on failure. Raises ``KVBackpressure``
+        through to the caller; with ``fallback`` disabled the aggregator
+        failure propagates instead of degrading."""
+        from .http_client import KVBackpressure, put_data_into_kvstore
+        if isinstance(value, str):
+            value = value.encode()
+        if self.agg is not None:
+            if not self.agg.tripped():
+                try:
+                    put_data_into_kvstore(self.agg, None, scope, key,
+                                          value, timeout=timeout, retries=0)
+                    with self._lock:
+                        if stream in self._warned:
+                            self._warned.discard(stream)
+                            recovered = True
+                        else:
+                            recovered = False
+                    if recovered:
+                        logger.warning(
+                            "slice %d aggregator recovered; %s publishes "
+                            "ride the hierarchy again.", self.slice_index,
+                            stream)
+                    return
+                except KVBackpressure:
+                    raise
+                except Exception as e:
+                    if not self.fallback:
+                        raise
+                    self._note_fallback(stream, e)
+            else:
+                if not self.fallback:
+                    raise OSError(
+                        f"slice {self.slice_index} aggregator breaker open "
+                        f"and HOROVOD_TPU_AGG_FALLBACK is off")
+                self._note_fallback(stream, None)
+        put_data_into_kvstore(self.kv[0], self.kv[1], scope, key, value,
+                              timeout=timeout, retries=1)
+
+    def _note_fallback(self, stream: str, err):
+        self._m_fallback.inc(stream=stream)
+        with self._lock:
+            first = stream not in self._warned
+            if first:
+                self._warned.add(stream)
+        if first:
+            logger.warning(
+                "slice %d aggregator %s unreachable for %s publishes "
+                "(%s); falling back DIRECT to the root KV (counted in "
+                "hvd_tpu_agg_fallback_total) until it recovers.",
+                self.slice_index,
+                self.agg.spec if self.agg is not None else "?", stream,
+                err if err is not None else "circuit breaker open")
+        else:
+            logger.debug("slice %d aggregator fallback (%s): %s",
+                         self.slice_index, stream, err)

@@ -169,6 +169,16 @@ class Endpoints:
                 if self._state[i].open_until > now)]
         return closed + opened
 
+    def tripped(self, i: int = 0) -> bool:
+        """Whether endpoint ``i``'s breaker is currently open (its reopen
+        instant not yet reached). Single-endpoint callers — the telemetry
+        route's slice-aggregator leg — use this to skip the attempt
+        entirely while the breaker is open instead of paying a connect
+        timeout per publish; once the reopen instant passes this returns
+        False and the next publish is the half-open probe."""
+        with self._lock:
+            return self._state[i].open_until > time.monotonic()
+
     def record_success(self, i: int, prefer: bool = True):
         """A request completed against endpoint ``i``: close its breaker.
         ``prefer`` pins it as the sticky first candidate (writes — the

@@ -53,6 +53,12 @@ CLOCK_SCOPE = "clock"
 # reserved replication-control scope (runner/replication.py): PUT apply/
 # snapshot between replicas, GET status/journal for operators and tests
 REPL_SCOPE = "_repl"
+# KV scope carrying slice-aggregator registrations ("<slice>") and
+# telemetry rollups ("<stream>/<slice>") — == runner/aggregator.py
+# AGG_KV_SCOPE, kept literal for the same standalone-import reason.
+# GET /agg (empty key) serves a JSON summary of the aggregation tier
+# (tools/health_report.py's freshness source).
+AGG_SCOPE = "agg"
 
 
 def _normalize(result) -> Tuple[int, dict, bytes]:
@@ -90,6 +96,7 @@ class _KVHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802
         scope, key = self._split()
+        self.server._count_request("get", scope, 0)
         value = self.server.handle_get(scope, key, self)
         if value is None:
             self.send_response(NOT_FOUND)
@@ -99,7 +106,7 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.send_response(OK)
         if scope == METRICS_SCOPE and not key:
             self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
-        elif (scope in (TRACE_SCOPE, CLOCK_SCOPE, REPL_SCOPE)) and \
+        elif (scope in (TRACE_SCOPE, CLOCK_SCOPE, REPL_SCOPE, AGG_SCOPE)) and \
                 (not key or scope == REPL_SCOPE):
             self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(value)))
@@ -110,12 +117,14 @@ class _KVHandler(BaseHTTPRequestHandler):
         scope, key = self._split()
         length = int(self.headers.get("Content-Length", "0"))
         value = self.rfile.read(length)
+        self.server._count_request("put", scope, length)
         self._reply(self.server.handle_put(scope, key, value, self))
 
     def do_DELETE(self):  # noqa: N802
         # idempotent key removal (checkpoint GC drops stale chunked shard
         # values; see http_client.delete_data_from_kvstore)
         scope, key = self._split()
+        self.server._count_request("delete", scope, 0)
         self._reply(self.server.handle_delete(scope, key, self))
 
 
@@ -137,6 +146,7 @@ class KVStoreServer(ThreadingHTTPServer):
         "_scope_bytes": "_lock",
         "_record_meta": "_lock",
         "_slots_by_key": "_lock",
+        "_request_stats": "_lock",
         "_skew_watermark": "_trace_render_lock",
     }
 
@@ -182,6 +192,16 @@ class KVStoreServer(ThreadingHTTPServer):
         # the same collectives)
         self._skew_watermark: Dict[str, tuple] = {}
         self._trace_render_lock = threading.Lock()
+        # server-side request accounting (ISSUE 18): root load is measured,
+        # not inferred. The registry counters are process-wide (the scrape
+        # face); the per-instance table lets an in-process test or bench
+        # attribute load to ONE server when several share the process
+        # (root vs embedded slice-aggregator receivers).
+        self._request_stats: Dict[Tuple[str, str], list] = {}
+        from ..metrics import registry as _metrics_registry
+        _reg = _metrics_registry()
+        self._m_requests = _reg.counter("hvd_tpu_kv_requests_total")
+        self._m_request_bytes = _reg.counter("hvd_tpu_kv_request_bytes_total")
 
     # -- public state accessors ---------------------------------------------
 
@@ -209,6 +229,25 @@ class KVStoreServer(ThreadingHTTPServer):
     def scope_bytes(self, scope: str) -> int:
         with self._lock:
             return self._scope_bytes.get(scope, 0)
+
+    def _count_request(self, verb: str, scope: str, nbytes: int):
+        """One HTTP request landed on this server: count it per
+        (verb, scope) into the instance table and the process registry
+        (``hvd_tpu_kv_requests_total`` / ``hvd_tpu_kv_request_bytes_total``
+        — the O(ranks) vs O(slices) root-load claim, measured)."""
+        with self._lock:
+            ent = self._request_stats.setdefault((verb, scope), [0, 0])
+            ent[0] += 1
+            ent[1] += int(nbytes)
+        self._m_requests.inc(verb=verb, scope=scope)
+        if nbytes:
+            self._m_request_bytes.inc(int(nbytes), verb=verb, scope=scope)
+
+    def request_stats(self) -> Dict[Tuple[str, str], Tuple[int, int]]:
+        """Copy of the per-instance request table:
+        ``(verb, scope) -> (requests, bytes)``."""
+        with self._lock:
+            return {k: (v[0], v[1]) for k, v in self._request_stats.items()}
 
     def set_scope_budget(self, scope: str, budget_bytes: int):
         """Per-scope byte-budget override (0 disables); the knob
@@ -335,6 +374,8 @@ class KVStoreServer(ThreadingHTTPServer):
             # rtt/2 midpoint estimate stays tight.
             import time
             return json.dumps({"ts": time.time()}).encode()
+        if scope == AGG_SCOPE and not key:
+            return self._render_agg_summary()
         if scope == REPL_SCOPE:
             if self._repl is None:
                 return None
@@ -355,10 +396,34 @@ class KVStoreServer(ThreadingHTTPServer):
         with self._lock:
             return self._store.get(scope, {}).get(key)
 
+    def _agg_rollups(self, stream: str) -> Dict[str, dict]:
+        """Parsed ``agg/<stream>/<slice>`` rollup payloads, keyed by slice
+        string (unparseable rollups are skipped)."""
+        out: Dict[str, dict] = {}
+        prefix = stream + "/"
+        for key, raw in self.snapshot(AGG_SCOPE).get(AGG_SCOPE, {}).items():
+            if not key.startswith(prefix):
+                continue
+            try:
+                out[key[len(prefix):]] = json.loads(raw)
+            except Exception:
+                _LOG.debug("unparseable %s rollup under agg/%s", stream, key)
+        return out
+
     def _render_metrics(self) -> bytes:
         from ..metrics import registry, render_prometheus_cluster
-        payloads = self.snapshot(METRICS_SCOPE).get(METRICS_SCOPE, {})
         snaps = {}
+        # aggregator rollups first (ISSUE 18): each carries its slice's
+        # per-rank snapshots (cardinality=rank) or one summed per-slice
+        # snapshot (cardinality=slice) — the root never needed N keys
+        for slice_key, roll in self._agg_rollups(METRICS_SCOPE).items():
+            rolled = roll.get("snaps")
+            if isinstance(rolled, dict):
+                snaps.update(rolled)
+        # direct rank keys overlay the rollups: a direct key only exists on
+        # flat topologies (no rollups at all) or for a rank that FELL BACK
+        # past its aggregator — whose rollup copy is by definition frozen
+        payloads = self.snapshot(METRICS_SCOPE).get(METRICS_SCOPE, {})
         for rank, raw in payloads.items():
             try:
                 snaps[rank] = json.loads(raw)
@@ -384,10 +449,47 @@ class KVStoreServer(ThreadingHTTPServer):
         rides the ``GET /metrics`` scrape (rank="driver")."""
         from ..metrics import registry
         from ..trace import render_cluster_trace
-        payloads = self.snapshot(TRACE_SCOPE).get(TRACE_SCOPE, {})
+        # aggregator trace rollups first (segments already edge-aligned to
+        # this server's wall clock, pid pinned to rank), then direct
+        # ``trace/<rank>`` keys overlay them (flat topologies + fallback
+        # ranks — the fresher copy for any rank publishing direct)
+        payloads: Dict[str, object] = {}
+        for slice_key, roll in self._agg_rollups(TRACE_SCOPE).items():
+            segs = roll.get("segments")
+            if isinstance(segs, dict):
+                payloads.update(segs)
+        payloads.update(self.snapshot(TRACE_SCOPE).get(TRACE_SCOPE, {}))
         with self._trace_render_lock:
             return render_cluster_trace(payloads, reg=registry(),
                                         watermark=self._skew_watermark)
+
+    def _render_agg_summary(self) -> bytes:
+        """The ``GET /agg`` body: aggregation-tier state as JSON —
+        per-slice registrations, per-stream rollup freshness/size, and
+        this server's request accounting (tools/health_report.py's
+        per-slice publish-freshness and control-plane-load source)."""
+        import time
+        slices: Dict[str, dict] = {}
+        rollups: Dict[str, dict] = {}
+        for key, raw in self.snapshot(AGG_SCOPE).get(AGG_SCOPE, {}).items():
+            try:
+                payload = json.loads(raw)
+            except Exception:
+                continue
+            if "/" in key:
+                stream, _, slice_key = key.partition("/")
+                rollups.setdefault(stream, {})[slice_key] = {
+                    "ts": payload.get("ts"), "bytes": len(raw),
+                    "ranks": sorted(payload.get("snaps")
+                                    or payload.get("segments")
+                                    or payload.get("reports") or ())}
+            else:
+                slices[key] = payload
+        stats = {f"{verb} {scope}": {"requests": n, "bytes": b}
+                 for (verb, scope), (n, b) in self.request_stats().items()}
+        return json.dumps({"ts": time.time(), "slices": slices,
+                           "rollups": rollups,
+                           "request_stats": stats}).encode()
 
     def clear_scope(self, scope: str):
         """Drop every key under one scope (the elastic driver clears stale

@@ -68,11 +68,21 @@ class StallInspector:
                  rank: int = 0, size: int = 1,
                  collective_deadline: float = 0.0,
                  escalate: Optional[Callable[[Exception], None]] = None,
-                 flight_dump: Optional[Callable[[], Optional[str]]] = None):
+                 flight_dump: Optional[Callable[[], Optional[str]]] = None,
+                 route=None, topology=None, agg_interval: float = 5.0):
         self.warning_seconds = warning_seconds
         self.shutdown_seconds = shutdown_seconds
         self.collective_deadline = collective_deadline
         self.escalate = escalate
+        # ISSUE 18 hierarchical telemetry: publishes ride the slice
+        # aggregator via the shared TelemetryRoute, and rank 0's sweep
+        # reads O(slices) stall rollups instead of O(N) rank keys when a
+        # hierarchical topology is wired (flat topologies keep the direct
+        # path). agg_interval bounds how stale a healthy rollup's per-rank
+        # report can legitimately be.
+        self.route = route
+        self.topology = topology
+        self.agg_interval = max(float(agg_interval), 0.05)
         # flight recorder (horovod_tpu/trace.py, wired by GlobalState):
         # called exactly once, before the escalate hook poisons the engine
         # (and before a shutdown-tier process abort), to dump the last-N
@@ -202,9 +212,13 @@ class StallInspector:
             # logic above owns persistent-outage escalation
             encoded = json.dumps(payload).encode()
             try:
-                put_data_into_kvstore(self.kv[0], self.kv[1], KV_SCOPE,
-                                      str(self.rank), encoded, timeout=5,
-                                      retries=1)
+                if self.route is not None:
+                    self.route.put("stall", KV_SCOPE, str(self.rank),
+                                   encoded, timeout=5)
+                else:
+                    put_data_into_kvstore(self.kv[0], self.kv[1], KV_SCOPE,
+                                          str(self.rank), encoded, timeout=5,
+                                          retries=1)
             except KVBackpressure:
                 # deliberate server shedding (scope byte budget) — not an
                 # outage: count the shed bytes, skip this tick, and leave
@@ -229,16 +243,64 @@ class StallInspector:
 
     def _read_reports(self, timeout: float = 1.0) -> Dict[int, dict]:
         """Fetch every rank's liveness report from the KV (best-effort;
-        absent/unparseable ranks are skipped)."""
+        absent/unparseable ranks are skipped).
+
+        Hierarchical path (ISSUE 18): with a multislice topology and a
+        telemetry route wired, read the O(slices) ``agg/stall/<slice>``
+        rollups and reconstruct per-rank reports from them — the O(N)
+        per-sweep KV load noted since PR 7 becomes O(slices). Ranks a
+        rollup does not cover freshly (fallback ranks, a dead aggregator's
+        whole slice) are direct-read individually, so stall detection
+        survives the aggregator tier dying; a stale rollup report is still
+        kept when the direct read also fails (its old timestamp is exactly
+        what the silent-rank warning needs). Flat topologies keep the
+        direct O(N) sweep."""
         from .runner.http_client import read_data_from_kvstore
         reports: Dict[int, dict] = {}
+        stale: Dict[int, dict] = {}
+        topo = self.topology
+        if topo is not None and getattr(topo, "hierarchical_ok", False) and \
+                self.route is not None:
+            # a rollup report is legitimately behind by up to one publish
+            # cadence plus one rollup cadence; past 3x that it is stale
+            # enough to re-check directly
+            stale_after = 3.0 * (self.check_interval + self.agg_interval)
+            now = time.time()
+            for k in range(topo.num_slices):
+                try:
+                    # short timeout: a missing rollup key long-polls, and
+                    # a degraded tier must not stretch the sweep by
+                    # num_slices x timeout
+                    raw = read_data_from_kvstore(
+                        self.kv[0], self.kv[1], "agg", f"stall/{k}",
+                        timeout=min(timeout, 0.3), poll_interval=0.1)
+                    roll = json.loads(raw)
+                except Exception:
+                    continue
+                out_map = roll.get("outstanding", {})
+                for r_s, rep in roll.get("reports", {}).items():
+                    try:
+                        r = int(r_s)
+                    except ValueError:
+                        continue
+                    rep = dict(rep)
+                    rep["outstanding"] = sorted(
+                        n for n, rs in out_map.items() if r in rs)
+                    if now - rep.get("ts", 0.0) <= stale_after:
+                        reports[r] = rep
+                    else:
+                        stale[r] = rep
         for r in range(self.size):
+            if r in reports:
+                continue
             try:
                 raw = read_data_from_kvstore(self.kv[0], self.kv[1], KV_SCOPE,
                                              str(r), timeout=timeout,
                                              poll_interval=0.1)
                 reports[r] = json.loads(raw)
             except Exception:
+                if r in stale:
+                    reports[r] = stale[r]
                 continue
         return reports
 

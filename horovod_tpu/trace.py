@@ -208,6 +208,14 @@ class TraceRecorder:
             self._beacons.append((float(local_mono), float(server_ts),
                                   float(rtt)))
 
+    def reset_beacons(self):
+        """Drop every alignment pair. The TracePublisher calls this when
+        its beacon target flips (slice aggregator <-> root on a telemetry-
+        route fallback): beacons against two different server clocks must
+        never mix in one min-rtt selection."""
+        with self._lock:
+            self._beacons.clear()
+
     # -- export --------------------------------------------------------------
 
     def segment(self, max_bytes: int = DEFAULT_SEGMENT_MAX_BYTES) -> dict:
@@ -264,12 +272,15 @@ class TraceRecorder:
 # ---------------------------------------------------------------------------
 
 def publish_segment(kv: Tuple[str, int], rank: int, segment,
-                    timeout: float = 5.0):
+                    timeout: float = 5.0, route=None):
     """PUT one trace segment (dict, or pre-encoded bytes from
     :meth:`TraceRecorder.segment_bytes`) to the rendezvous KV under
     ``trace/<rank>``. Carries the ``trace.publish`` failpoint so a
     silently-dropped publish is injectable (the chaos suite proves the
-    merged ``/trace`` degrades gracefully instead of failing)."""
+    merged ``/trace`` degrades gracefully instead of failing). With a
+    ``route`` (:class:`..runner.aggregator.TelemetryRoute`), the segment
+    rides the slice aggregator tier — the aggregator clock-aligns it at
+    the edge and folds it into ONE rollup per interval."""
     from .faults import DROP, failpoint
     from .runner.http_client import (KVBackpressure, count_shed_bytes,
                                      put_data_into_kvstore)
@@ -280,8 +291,12 @@ def publish_segment(kv: Tuple[str, int], rank: int, segment,
     elif not isinstance(segment, (bytes, bytearray)):
         segment = json.dumps(segment).encode()
     try:
-        put_data_into_kvstore(kv[0], kv[1], TRACE_KV_SCOPE, str(rank),
-                              segment, timeout=timeout, retries=1)
+        if route is not None:
+            route.put("trace", TRACE_KV_SCOPE, str(rank), segment,
+                      timeout=timeout)
+        else:
+            put_data_into_kvstore(kv[0], kv[1], TRACE_KV_SCOPE, str(rank),
+                                  segment, timeout=timeout, retries=1)
     except KVBackpressure:
         # server backpressure (scope byte budget): shed this segment —
         # the ring already drops oldest-first, so the loss is the oldest
@@ -297,12 +312,14 @@ class TracePublisher(threading.Thread):
     and swallowed — telemetry must never take the job down."""
 
     def __init__(self, recorder: TraceRecorder, kv: Tuple[str, int],
-                 rank: int = 0, interval: float = 5.0):
+                 rank: int = 0, interval: float = 5.0, route=None):
         super().__init__(name="hvd-trace", daemon=True)
         self.recorder = recorder
         self.kv = kv
         self.rank = rank
         self.interval = max(float(interval), 0.05)
+        self.route = route
+        self._clock_target = None
         self._stop_evt = threading.Event()
         from .metrics import registry as metrics_registry
         self._m_pub_failures = metrics_registry().counter(
@@ -321,14 +338,27 @@ class TracePublisher(threading.Thread):
 
     def tick(self):
         from .runner.http_client import fetch_server_clock
+        # beacons pair against whatever clock the segment's consumer
+        # aligns with: the slice aggregator while routing through it (it
+        # re-aligns to root wall at the edge), the root otherwise. When
+        # the target flips (aggregator death/recovery), the old beacons
+        # belong to a different server clock — drop them.
+        target = self.route.clock_target() if self.route is not None \
+            else self.kv
+        if self._clock_target is not None and \
+                target is not self._clock_target and \
+                target != self._clock_target:
+            self.recorder.reset_beacons()
+        self._clock_target = target
         try:
-            mono, server_ts, rtt = fetch_server_clock(self.kv[0], self.kv[1])
+            mono, server_ts, rtt = fetch_server_clock(target[0], target[1])
             self.recorder.add_beacon(mono, server_ts, rtt)
         except Exception as e:
             logger.debug("trace clock beacon failed: %s", e)
         try:
             publish_segment(self.kv, self.rank,
-                            self.recorder.segment_bytes())
+                            self.recorder.segment_bytes(),
+                            route=self.route)
         except Exception as e:
             self._m_pub_failures.inc()
             logger.debug("trace segment publish failed: %s", e)

@@ -1,0 +1,617 @@
+"""Hierarchical telemetry fabric tests (ISSUE 18): TelemetryRoute
+routing + loud fallback, SliceAggregator rollups, the np=4 two-slice
+scrape reconciliation (aggregated ``GET /metrics`` == union of per-rank
+snapshots), trace merge parity through the aggregator tier (aggregated
+``GET /trace`` passes ``tools/trace_report.py --check``), the stall
+sweep's O(slices) KV read count, server-side request accounting, and the
+SIGKILL-the-aggregator chaos case (fallback publishes counted, zero lost
+stall reports)."""
+
+import contextlib
+import importlib.util
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from horovod_tpu import metrics as hmetrics
+from horovod_tpu.metrics import Registry
+from horovod_tpu.parallel.mesh import Topology
+from horovod_tpu.runner.aggregator import (SliceAggregator, TelemetryRoute,
+                                           _sum_snapshots)
+from horovod_tpu.runner.http_client import (put_data_into_kvstore,
+                                            read_data_from_kvstore)
+from horovod_tpu.runner.http_server import KVStoreServer, find_free_port
+from horovod_tpu.stall_inspector import StallInspector
+from horovod_tpu.trace import TraceRecorder, publish_segment
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
+_LABEL_PAIR_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_prom(text):
+    samples = []
+    for line in text.splitlines():
+        if not line.strip() or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        name, labelstr, val = m.groups()
+        labels = dict(_LABEL_PAIR_RE.findall(labelstr)) if labelstr else {}
+        v = float("inf") if val == "+Inf" else float(val)
+        samples.append((name, labels, v))
+    return samples
+
+
+@contextlib.contextmanager
+def _isolated_registry():
+    """Fresh process registry (the test_metrics.py discipline): routes,
+    aggregators and servers cache their counters at construction, so
+    everything under test is built inside this context."""
+    with hmetrics._registry_lock:
+        saved = hmetrics._registry
+        hmetrics._registry = Registry()
+    try:
+        yield
+    finally:
+        with hmetrics._registry_lock:
+            hmetrics._registry = saved
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _snap(rank):
+    """A synthetic per-rank registry snapshot with rank-distinct values."""
+    v = float(rank + 1)
+    return {
+        "enabled": True,
+        "counters": {
+            "hvd_tpu_steps_total": {
+                "help": "steps", "values": [[{}, 10.0 * v]]},
+            "hvd_tpu_dispatches_total": {
+                "help": "d", "values": [[{"kind": "allreduce"}, v]]}},
+        "gauges": {
+            "hvd_tpu_elastic_world_version": {
+                "help": "wv", "values": [[{}, 3.0]]}},
+        "histograms": {
+            "hvd_tpu_op_latency_seconds": {
+                "help": "lat",
+                "values": [[{}, {"sum": v, "count": int(v),
+                                 "buckets": [[0.001, 0],
+                                             [1.0, int(v)]]}]]}},
+        "events": {}}
+
+
+@contextlib.contextmanager
+def _fabric(num_slices=2, local_size=2, interval=60.0, cardinality="rank"):
+    """Root server + one aggregator per slice + one resolved route per
+    rank, torn down in order."""
+    root = KVStoreServer(("127.0.0.1", 0))
+    port = root.start()
+    kv = ("127.0.0.1", port)
+    aggs, routes = [], []
+    try:
+        for k in range(num_slices):
+            a = SliceAggregator(
+                kv, slice_index=k,
+                ranks=list(range(k * local_size, (k + 1) * local_size)),
+                interval=interval, cardinality=cardinality,
+                rank=k * local_size, advertise_host="127.0.0.1")
+            a.start()
+            aggs.append(a)
+        for r in range(num_slices * local_size):
+            routes.append(TelemetryRoute.resolve(kv, r // local_size,
+                                                 timeout=5))
+        yield kv, port, aggs, routes
+    finally:
+        for a in aggs:
+            a.stop(final_rollup=False)
+        root.stop()
+
+
+# ---------------------------------------------------------------------------
+# routing
+# ---------------------------------------------------------------------------
+
+class TestTelemetryRoute:
+    def test_publish_rides_aggregator_then_rolls_up(self):
+        with _isolated_registry(), _fabric(num_slices=1) as \
+                (kv, port, aggs, routes):
+            routes[1].put("metrics", "metrics", "1",
+                          json.dumps(_snap(1)))
+            # the payload landed on the aggregator's embedded receiver,
+            # NOT the root
+            assert "1" in aggs[0].server.snapshot("metrics")["metrics"]
+            root_metrics = KVStoreServer.snapshot  # readability only
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/agg", timeout=5) as resp:
+                before = json.loads(resp.read())
+            assert before["rollups"] == {}
+            aggs[0].rollup_once()
+            raw = read_data_from_kvstore(kv[0], kv[1], "agg", "metrics/0",
+                                         timeout=2)
+            roll = json.loads(raw)
+            assert roll["slice"] == 0 and "1" in roll["snaps"]
+
+    def test_resolve_without_registration_degrades(self, caplog):
+        root = KVStoreServer(("127.0.0.1", 0))
+        port = root.start()
+        try:
+            with caplog.at_level("WARNING", logger="horovod_tpu.runner"):
+                route = TelemetryRoute.resolve(("127.0.0.1", port), 0,
+                                               timeout=0.3)
+            assert not route.hierarchical
+            assert any("direct to the root" in r.message
+                       for r in caplog.records)
+            # publishes still work, straight to the root, uncounted (no
+            # aggregator was ever configured on this route)
+            route.put("stall", "stall", "0", b"{}")
+            assert "0" in root.snapshot("stall")["stall"]
+        finally:
+            root.stop()
+
+    def test_fallback_on_dead_aggregator(self, caplog):
+        with _isolated_registry():
+            root = KVStoreServer(("127.0.0.1", 0))
+            port = root.start()
+            dead = find_free_port()
+            try:
+                route = TelemetryRoute(("127.0.0.1", port), 0,
+                                       ("127.0.0.1", dead))
+                reg = hmetrics.registry()
+                with caplog.at_level("WARNING",
+                                     logger="horovod_tpu.runner"):
+                    route.put("metrics", "metrics", "0",
+                              json.dumps(_snap(0)))
+                # landed direct at the root, counted and warned
+                assert "0" in root.snapshot("metrics")["metrics"]
+                fb = reg.counter("hvd_tpu_agg_fallback_total")
+                assert fb.total() >= 1
+                assert any("hvd_tpu_agg_fallback_total" in r.message
+                           for r in caplog.records)
+                # the breaker trips after the configured failure streak;
+                # once open, the clock target flips to the root
+                for _ in range(4):
+                    route.put("metrics", "metrics", "0", b"{}")
+                assert route.agg.tripped()
+                assert route.clock_target() == ("127.0.0.1", port)
+            finally:
+                root.stop()
+
+    def test_fallback_disabled_raises(self):
+        with _isolated_registry():
+            root = KVStoreServer(("127.0.0.1", 0))
+            port = root.start()
+            dead = find_free_port()
+            try:
+                route = TelemetryRoute(("127.0.0.1", port), 0,
+                                       ("127.0.0.1", dead), fallback=False)
+                with pytest.raises(Exception):
+                    route.put("metrics", "metrics", "0", b"{}")
+                assert "metrics" not in root.snapshot("metrics").get(
+                    "metrics", {})
+            finally:
+                root.stop()
+
+
+# ---------------------------------------------------------------------------
+# metrics reconciliation (the acceptance bar: aggregated scrape == union
+# of per-rank snapshots)
+# ---------------------------------------------------------------------------
+
+class TestScrapeReconciliation:
+    def test_np4_two_slice_scrape_equals_rank_union(self):
+        with _isolated_registry(), _fabric() as (kv, port, aggs, routes):
+            for r, route in enumerate(routes):
+                route.put("metrics", "metrics", str(r),
+                          json.dumps(_snap(r)))
+            for a in aggs:
+                a.rollup_once()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+                samples = _parse_prom(resp.read().decode())
+            by_name = {}
+            for name, labels, v in samples:
+                by_name.setdefault(name, {})[labels.get("rank")] = \
+                    (labels, v)
+            # every rank's series present with exactly its published value
+            steps = by_name["hvd_tpu_steps_total"]
+            assert set(steps) == {"0", "1", "2", "3"}
+            for r in range(4):
+                assert steps[str(r)][1] == 10.0 * (r + 1)
+                labels, v = by_name["hvd_tpu_dispatches_total"][str(r)]
+                assert labels["kind"] == "allreduce" and v == r + 1
+            # histogram sum/count series reconcile per rank too
+            sums = {ls.get("rank"): v for n, ls, v in samples
+                    if n == "hvd_tpu_op_latency_seconds_sum"}
+            assert sums == {str(r): float(r + 1) for r in range(4)}
+
+    def test_direct_key_overlays_stale_rollup(self):
+        """A rank that fell back publishes direct; its direct (fresher)
+        copy must win over the frozen rollup copy at render time."""
+        with _isolated_registry(), _fabric(num_slices=1) as \
+                (kv, port, aggs, routes):
+            routes[0].put("metrics", "metrics", "0", json.dumps(_snap(0)))
+            aggs[0].rollup_once()        # rollup carries steps_total=10
+            fresher = _snap(0)
+            fresher["counters"]["hvd_tpu_steps_total"]["values"] = \
+                [[{}, 999.0]]
+            put_data_into_kvstore(kv[0], kv[1], "metrics", "0",
+                                  json.dumps(fresher).encode(), timeout=5)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+                samples = _parse_prom(resp.read().decode())
+            vals = [v for n, ls, v in samples
+                    if n == "hvd_tpu_steps_total" and ls.get("rank") == "0"]
+            assert vals == [999.0]
+
+    def test_cardinality_slice_presums(self):
+        with _isolated_registry(), \
+                _fabric(num_slices=1, cardinality="slice") as \
+                (kv, port, aggs, routes):
+            for r in (0, 1):
+                routes[r].put("metrics", "metrics", str(r),
+                              json.dumps(_snap(r)))
+            aggs[0].rollup_once()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+                samples = _parse_prom(resp.read().decode())
+            by_rank = {ls.get("rank"): v for n, ls, v in samples
+                       if n == "hvd_tpu_steps_total"}
+            # ONE synthetic slice series carrying the sum, no rank series
+            assert by_rank == {"slice0": 30.0}
+            counts = {ls.get("rank"): v for n, ls, v in samples
+                      if n == "hvd_tpu_op_latency_seconds_count"}
+            assert counts == {"slice0": 3.0}
+            # gauges merge as max, not sum (world version stays 3)
+            wv = {ls.get("rank"): v for n, ls, v in samples
+                  if n == "hvd_tpu_elastic_world_version"}
+            assert wv == {"slice0": 3.0}
+
+    def test_sum_snapshots_shapes(self):
+        merged = _sum_snapshots([_snap(0), _snap(3)])
+        assert merged["counters"]["hvd_tpu_steps_total"]["values"] == \
+            [[{}, 50.0]]
+        h = merged["histograms"]["hvd_tpu_op_latency_seconds"]["values"]
+        [(labels, hist)] = h
+        assert hist["count"] == 5 and hist["sum"] == 5.0
+        assert sorted(hist["buckets"]) == [[0.001, 0], [1.0, 5]]
+
+
+# ---------------------------------------------------------------------------
+# trace merge parity
+# ---------------------------------------------------------------------------
+
+class TestTraceParity:
+    def test_aggregated_trace_passes_schema_check(self):
+        from horovod_tpu.runner.http_client import fetch_server_clock
+        trace_report = _load_tool("trace_report")
+        with _isolated_registry(), _fabric() as (kv, port, aggs, routes):
+            for r, route in enumerate(routes):
+                rec = TraceRecorder(rank=r, capacity=256)
+                # beacon against the route's clock target (the slice
+                # aggregator), exactly what TracePublisher.tick does
+                target = route.clock_target()
+                mono, server_ts, rtt = fetch_server_clock(target[0],
+                                                          target[1])
+                rec.add_beacon(mono, server_ts, rtt)
+                corr = rec.record_enqueue("grad", "allreduce", 1024,
+                                          world_version=1)
+                rec.record_dispatch("grad", "launch", 0.001)
+                rec.record_done("grad")
+                publish_segment(kv, r, rec.segment_bytes(), route=route)
+                # publish rode the aggregator, not the root
+                assert str(r) in \
+                    aggs[r // 2].server.snapshot("trace")["trace"]
+                assert "trace" not in \
+                    routes[0].kv and True  # routes hold tuples, not stores
+            for a in aggs:
+                a.rollup_once()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/trace", timeout=5) as resp:
+                payload = json.loads(resp.read())
+            events = payload["traceEvents"]
+            errors = trace_report.check_events(events)
+            assert errors == [], errors
+            pids = {ev["pid"] for ev in events if ev.get("ph") == "B"}
+            assert pids == {0, 1, 2, 3}
+            # edge alignment happened: every segment reached the root
+            # pre-aligned (identity beacons), so no rank rendered as
+            # (unaligned)
+            names = {ev.get("args", {}).get("rank_label", "")
+                     for ev in events if ev.get("ph") == "M"}
+            assert not any("unaligned" in str(n) for n in names), names
+
+    def test_beaconless_segment_passes_through(self):
+        with _isolated_registry(), _fabric(num_slices=1) as \
+                (kv, port, aggs, routes):
+            rec = TraceRecorder(rank=0, capacity=64)
+            rec.record_enqueue("g", "allreduce", 8, world_version=1)
+            rec.record_done("g")
+            publish_segment(kv, 0, rec.segment_bytes(), route=routes[0])
+            aggs[0].rollup_once()
+            raw = read_data_from_kvstore(kv[0], kv[1], "agg", "trace/0",
+                                         timeout=2)
+            seg = json.loads(raw)["segments"]["0"]
+            # no beacons -> no shift applied, beacons stay empty (the
+            # root renders it unaligned instead of mis-aligned)
+            assert seg["beacons"] == []
+
+
+# ---------------------------------------------------------------------------
+# stall sweep: O(slices) root reads
+# ---------------------------------------------------------------------------
+
+def _stall_report(rank, outstanding=()):
+    return {"ts": time.time(), "hb_step": 7, "hb_ts": time.time(),
+            "hb_idle": False, "replay_fallbacks": 0,
+            "outstanding": list(outstanding)}
+
+
+class TestStallSweep:
+    def _inspector(self, kv, topo, route):
+        return StallInspector(
+            warning_seconds=60.0, check_interval=30.0, kv=kv, rank=0,
+            size=4, route=route, topology=topo, agg_interval=5.0)
+
+    def test_hierarchical_sweep_reads_o_slices(self, monkeypatch):
+        """The regression pin: a 4-rank/2-slice sweep costs 2 rollup
+        reads, not 4 rank reads."""
+        with _isolated_registry(), _fabric() as (kv, port, aggs, routes):
+            for r, route in enumerate(routes):
+                route.put("stall", "stall", str(r), json.dumps(
+                    _stall_report(r, ["grad"] if r != 3 else [])))
+            for a in aggs:
+                a.rollup_once()
+            import horovod_tpu.runner.http_client as hc
+            calls = []
+            real_read = hc.read_data_from_kvstore
+
+            def counting_read(addr, port_, scope, key, **kw):
+                calls.append((scope, key))
+                return real_read(addr, port_, scope, key, **kw)
+
+            monkeypatch.setattr(hc, "read_data_from_kvstore",
+                                counting_read)
+            topo = Topology(size=4, local_size=2)
+            insp = self._inspector(kv, topo, routes[0])
+            try:
+                reports = insp._read_reports(timeout=1.0)
+            finally:
+                insp.stop()
+            assert sorted(reports) == [0, 1, 2, 3]
+            assert len(calls) == 2, calls          # the O(slices) pin
+            assert all(scope == "agg" for scope, _ in calls), calls
+            # the rollup round-trip preserved the outstanding sets
+            assert reports[1]["outstanding"] == ["grad"]
+            assert reports[3]["outstanding"] == []
+
+    def test_flat_topology_keeps_direct_sweep(self, monkeypatch):
+        with _isolated_registry():
+            root = KVStoreServer(("127.0.0.1", 0))
+            port = root.start()
+            kv = ("127.0.0.1", port)
+            try:
+                for r in range(4):
+                    put_data_into_kvstore(
+                        kv[0], kv[1], "stall", str(r),
+                        json.dumps(_stall_report(r)).encode(), timeout=5)
+                import horovod_tpu.runner.http_client as hc
+                calls = []
+                real_read = hc.read_data_from_kvstore
+
+                def counting_read(addr, port_, scope, key, **kw):
+                    calls.append((scope, key))
+                    return real_read(addr, port_, scope, key, **kw)
+
+                monkeypatch.setattr(hc, "read_data_from_kvstore",
+                                    counting_read)
+                insp = self._inspector(kv, None, None)
+                try:
+                    reports = insp._read_reports(timeout=1.0)
+                finally:
+                    insp.stop()
+                assert sorted(reports) == [0, 1, 2, 3]
+                assert len(calls) == 4 and \
+                    all(scope == "stall" for scope, _ in calls), calls
+            finally:
+                root.stop()
+
+    def test_dead_aggregator_slice_direct_reads_survive(self):
+        """Slice 1's aggregator never rolled up; its ranks published
+        direct (fallback). The sweep still sees all four ranks."""
+        with _isolated_registry(), _fabric(num_slices=1) as \
+                (kv, port, aggs, routes):
+            for r in (0, 1):
+                routes[r].put("stall", "stall", str(r),
+                              json.dumps(_stall_report(r)))
+            aggs[0].rollup_once()
+            # ranks 2/3 of the dead-aggregator slice: direct keys only
+            for r in (2, 3):
+                put_data_into_kvstore(
+                    kv[0], kv[1], "stall", str(r),
+                    json.dumps(_stall_report(r)).encode(), timeout=5)
+            topo = Topology(size=4, local_size=2)
+            insp = self._inspector(kv, topo, routes[0])
+            try:
+                reports = insp._read_reports(timeout=1.0)
+            finally:
+                insp.stop()
+            assert sorted(reports) == [0, 1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# server-side request accounting
+# ---------------------------------------------------------------------------
+
+class TestRequestAccounting:
+    def test_counts_by_verb_and_scope(self):
+        with _isolated_registry():
+            server = KVStoreServer(("127.0.0.1", 0))
+            port = server.start()
+            kv = ("127.0.0.1", port)
+            try:
+                put_data_into_kvstore(kv[0], kv[1], "metrics", "0",
+                                      b"x" * 100, timeout=5)
+                put_data_into_kvstore(kv[0], kv[1], "metrics", "1",
+                                      b"y" * 50, timeout=5)
+                read_data_from_kvstore(kv[0], kv[1], "metrics", "0",
+                                       timeout=2)
+                stats = server.request_stats()
+                assert stats[("put", "metrics")] == (2, 150)
+                n_get, _ = stats[("get", "metrics")]
+                assert n_get >= 1
+                reg = hmetrics.registry()
+                snap = reg.snapshot()
+                series = {tuple(sorted(ls.items())): v for ls, v in
+                          snap["counters"]["hvd_tpu_kv_requests_total"]
+                          ["values"]}
+                assert series[(("scope", "metrics"),
+                               ("verb", "put"))] == 2.0
+                bseries = {tuple(sorted(ls.items())): v for ls, v in
+                           snap["counters"]
+                           ["hvd_tpu_kv_request_bytes_total"]["values"]}
+                assert bseries[(("scope", "metrics"),
+                                ("verb", "put"))] == 150.0
+                # and the /agg summary exposes the same table
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/agg", timeout=5) as resp:
+                    summary = json.loads(resp.read())
+                assert summary["request_stats"]["put metrics"] == \
+                    {"requests": 2, "bytes": 150}
+            finally:
+                server.stop()
+
+
+# ---------------------------------------------------------------------------
+# health report assembly (offline, against a live fabric)
+# ---------------------------------------------------------------------------
+
+class TestHealthReport:
+    def test_report_sections(self):
+        health = _load_tool("health_report")
+        with _isolated_registry(), _fabric() as (kv, port, aggs, routes):
+            for r, route in enumerate(routes):
+                route.put("metrics", "metrics", str(r),
+                          json.dumps(_snap(r)))
+            for a in aggs:
+                a.rollup_once()
+            report = health.assemble(f"http://127.0.0.1:{port}")
+            assert sorted(report["slices"]) == ["0", "1"]
+            for ent in report["slices"].values():
+                assert ent["rollup_age"]["metrics"] is not None
+                assert ent["rollup_age"]["metrics"] < 60
+            assert report["degradation"]["agg_fallbacks"]["total"] == 0
+            cp = report["control_plane"]
+            assert cp["total_requests"] > 0
+            assert cp["requests_per_step"] is not None
+            rendered = health.render(report)
+            assert "per-slice telemetry freshness" in rendered
+            assert "control-plane load" in rendered
+
+
+# ---------------------------------------------------------------------------
+# chaos: SIGKILL the aggregator mid-run
+# ---------------------------------------------------------------------------
+
+_AGG_SCRIPT = """
+import sys, time
+from horovod_tpu.runner.aggregator import SliceAggregator
+root_port = int(sys.argv[1])
+agg = SliceAggregator(("127.0.0.1", root_port), slice_index=0,
+                      ranks=[0, 1], interval=0.2, rank=0,
+                      advertise_host="127.0.0.1")
+agg.start()
+print("READY", flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+@pytest.mark.chaos
+class TestAggregatorKillChaos:
+    def test_sigkill_degrades_to_direct_without_losing_stall(
+            self, tmp_path, caplog):
+        with _isolated_registry():
+            root = KVStoreServer(("127.0.0.1", 0))
+            port = root.start()
+            kv = ("127.0.0.1", port)
+            script = tmp_path / "agg.py"
+            script.write_text(_AGG_SCRIPT)
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       PYTHONPATH=REPO_ROOT + os.pathsep +
+                       os.environ.get("PYTHONPATH", ""))
+            env.pop("HOROVOD_TPU_FAULTS", None)
+            proc = subprocess.Popen(
+                [sys.executable, str(script), str(port)],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                cwd=REPO_ROOT, env=env, text=True)
+            try:
+                line = proc.stdout.readline()
+                assert "READY" in line, \
+                    f"aggregator subprocess never came up: {line!r}"
+                routes = [TelemetryRoute.resolve(kv, 0, timeout=10)
+                          for _ in (0, 1)]
+                assert all(r.hierarchical for r in routes)
+                # hierarchy live: a publish reaches the root as a rollup
+                routes[0].put("stall", "stall", "0",
+                              json.dumps(_stall_report(0, ["grad"])))
+                raw = read_data_from_kvstore(kv[0], kv[1], "agg",
+                                             "stall/0", timeout=5)
+                assert "0" in json.loads(raw)["reports"]
+
+                os.kill(proc.pid, signal.SIGKILL)
+                proc.wait(timeout=10)
+
+                # every post-kill publish must land (direct), counted and
+                # warned — zero lost stall reports
+                reg = hmetrics.registry()
+                with caplog.at_level("WARNING",
+                                     logger="horovod_tpu.runner"):
+                    for r in (0, 1):
+                        routes[r].put("stall", "stall", str(r),
+                                      json.dumps(_stall_report(
+                                          r, [f"grad{r}"])))
+                direct = root.snapshot("stall")["stall"]
+                for r in (0, 1):
+                    rep = json.loads(direct[str(r)])
+                    assert rep["outstanding"] == [f"grad{r}"], rep
+                assert reg.counter(
+                    "hvd_tpu_agg_fallback_total").total() >= 2
+                assert any("falling back DIRECT" in rec.message
+                           for rec in caplog.records)
+                # rank 0's sweep still attributes all ranks: rank 0 via
+                # the (still-fresh) pre-kill rollup, rank 1 — which never
+                # made it into a rollup — via its direct fallback key
+                topo = Topology(size=4, local_size=2)
+                insp = StallInspector(
+                    warning_seconds=60.0, check_interval=30.0, kv=kv,
+                    rank=0, size=2, route=routes[0], topology=topo,
+                    agg_interval=0.2)
+                try:
+                    reports = insp._read_reports(timeout=1.0)
+                finally:
+                    insp.stop()
+                assert sorted(reports) == [0, 1]
+                assert reports[0]["outstanding"] == ["grad"]
+                assert reports[1]["outstanding"] == ["grad1"]
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait(timeout=10)
+                root.stop()

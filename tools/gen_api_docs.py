@@ -82,6 +82,8 @@ SECTIONS = [
     ("", "horovod_tpu.runner.http_client", [
         "Endpoints", "resolve_endpoints", "parse_endpoint_spec",
         "KVBackpressure"]),
+    ("Hierarchical telemetry", "horovod_tpu.runner.aggregator", [
+        "SliceAggregator", "TelemetryRoute"]),
     ("Estimator & store", "horovod_tpu", []),
     ("Models", "horovod_tpu.models.transformer", [
         "TransformerConfig", "init_params", "forward_block", "lean_lm_loss",

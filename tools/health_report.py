@@ -1,0 +1,307 @@
+"""Cluster health report over the root KV server's observability
+endpoints (ISSUE 18 — the operator's one-stop view of the hierarchical
+telemetry fabric).
+
+Input: the root server's base URL (``--url http://host:port``, the same
+server that serves the merged ``GET /metrics`` / ``GET /trace``). The
+report pulls three endpoints:
+
+- ``GET /agg`` — aggregator registrations, per-stream rollup freshness,
+  and the server's own per-(verb, scope) request accounting;
+- ``GET /metrics`` — the merged Prometheus scrape (fallback / shed /
+  failover counters, per-rank step counts);
+- ``GET /trace`` — the merged cluster trace (straggler ranking via
+  ``tools/trace_report.py`` analysis).
+
+Sections (``python tools/health_report.py --url http://host:port``):
+
+- **per-slice telemetry freshness** — each slice's aggregator address
+  and the age of its last ``metrics``/``trace``/``stall`` rollup (a
+  slice whose rollups stopped aging forward is a dead or wedged
+  aggregator; ranks then show up in the fallback counts instead);
+- **stragglers** — the trace analyzer's last-arrival ranking;
+- **degradation counters** — aggregator fallbacks
+  (``hvd_tpu_agg_fallback_total``), shed telemetry bytes
+  (``hvd_tpu_kv_shed_bytes_total``), KV failovers/breaker trips, lost
+  acked writes — every way the control plane degrades, with the
+  convention that nonzero is worth a look and zero is healthy;
+- **control-plane load** — ``hvd_tpu_kv_requests_total`` by verb and
+  scope plus requests-per-step (total KV requests over total cluster
+  steps): the number the aggregator tier exists to keep O(slices).
+
+``--json`` emits the assembled report as one JSON object instead.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_SERIES_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>[^\s]+)\s*$')
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text: str) -> Dict[str, List[Tuple[dict, float]]]:
+    """Parse a Prometheus text exposition into
+    ``name -> [(labels, value)]``. Tolerant: unparseable lines are
+    skipped (the report must work against future scrapes)."""
+    out: Dict[str, List[Tuple[dict, float]]] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SERIES_RE.match(line)
+        if m is None:
+            continue
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        labels = {k: v for k, v in _LABEL_RE.findall(m.group("labels") or "")}
+        out.setdefault(m.group("name"), []).append((labels, value))
+    return out
+
+
+def _fetch(url: str, timeout: float = 10.0) -> bytes:
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def _total(series: Dict[str, list], name: str, **match) -> float:
+    tot = 0.0
+    for labels, v in series.get(name, []):
+        if all(labels.get(k) == str(want) for k, want in match.items()):
+            tot += v
+    return tot
+
+
+def _by_label(series: Dict[str, list], name: str, label: str
+              ) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for labels, v in series.get(name, []):
+        key = labels.get(label, "")
+        out[key] = out.get(key, 0.0) + v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# report assembly
+# ---------------------------------------------------------------------------
+
+def slice_freshness(agg_summary: dict, now: Optional[float] = None) -> dict:
+    """Per-slice aggregator registration + rollup ages in seconds:
+    ``slice -> {addr, ranks, rollup_age: {stream: seconds|None}}``."""
+    if now is None:
+        now = time.time()
+    slices = agg_summary.get("slices", {}) or {}
+    rollups = agg_summary.get("rollups", {}) or {}
+    out: Dict[str, dict] = {}
+    for k, reg in sorted(slices.items(), key=lambda kv: str(kv[0])):
+        reg = reg if isinstance(reg, dict) else {}
+        ent = {"addr": reg.get("addr"), "ranks": reg.get("ranks"),
+               "rollup_age": {}}
+        for stream, per_slice in rollups.items():
+            roll = (per_slice or {}).get(str(k))
+            ts = roll.get("ts") if isinstance(roll, dict) else None
+            ent["rollup_age"][stream] = (
+                round(now - float(ts), 1)
+                if isinstance(ts, (int, float)) else None)
+        out[str(k)] = ent
+    return out
+
+
+def degradation_counters(series: Dict[str, list]) -> dict:
+    """Every counter that records a control-plane degradation, totalled
+    (and split by stream/scope where the labels carry attribution).
+    Zero everywhere = healthy."""
+    return {
+        "agg_fallbacks": {
+            "total": _total(series, "hvd_tpu_agg_fallback_total"),
+            "by_stream": _by_label(series, "hvd_tpu_agg_fallback_total",
+                                   "stream")},
+        "shed_bytes": {
+            "total": _total(series, "hvd_tpu_kv_shed_bytes_total"),
+            "by_scope": _by_label(series, "hvd_tpu_kv_shed_bytes_total",
+                                  "scope")},
+        "kv_failovers": _total(series, "hvd_tpu_kv_failover_total"),
+        "kv_breaker_trips": _total(series, "hvd_tpu_kv_breaker_open_total"),
+        "kv_backpressure": _total(series, "hvd_tpu_kv_backpressure_total"),
+        "kv_gave_up": _total(series, "hvd_tpu_kv_gave_up_total"),
+        "kv_acked_writes_lost": _total(
+            series, "hvd_tpu_kv_acked_writes_lost_total"),
+        "watchdog_escalations": _total(
+            series, "hvd_tpu_watchdog_escalations_total"),
+        "stall_publish_failures": _total(
+            series, "hvd_tpu_stall_publish_failures_total"),
+        "trace_publish_failures": _total(
+            series, "hvd_tpu_trace_publish_failures_total"),
+    }
+
+
+def control_plane_load(series: Dict[str, list],
+                       agg_summary: Optional[dict] = None) -> dict:
+    """KV request volume at the root by verb and scope, normalized per
+    cluster step — the O(slices)-vs-O(ranks) headline number."""
+    requests = _by_label(series, "hvd_tpu_kv_requests_total", "scope")
+    req_bytes = _by_label(series, "hvd_tpu_kv_request_bytes_total", "scope")
+    by_verb = _by_label(series, "hvd_tpu_kv_requests_total", "verb")
+    steps_by_rank = {
+        labels.get("rank", ""): v
+        for labels, v in series.get("hvd_tpu_steps_total", [])
+        if labels.get("rank", "") not in ("", "driver")}
+    total_steps = max(steps_by_rank.values()) if steps_by_rank else 0.0
+    total_requests = sum(requests.values())
+    out = {
+        "requests_by_scope": requests,
+        "request_bytes_by_scope": req_bytes,
+        "requests_by_verb": by_verb,
+        "total_requests": total_requests,
+        "cluster_steps": total_steps,
+        "steps_by_rank": steps_by_rank,
+        "requests_per_step": (
+            round(total_requests / total_steps, 2)
+            if total_steps > 0 else None),
+    }
+    if agg_summary:
+        out["server_request_stats"] = agg_summary.get("request_stats", {})
+    return out
+
+
+def assemble(url: str, timeout: float = 10.0) -> dict:
+    """Fetch all three endpoints and assemble the report dict. Each
+    endpoint degrades independently — a root without the /agg route (flat
+    topology, older server) still yields the metrics/trace sections."""
+    report: dict = {"url": url, "ts": time.time(), "errors": {}}
+    agg_summary: dict = {}
+    try:
+        agg_summary = json.loads(_fetch(url.rstrip("/") + "/agg", timeout))
+    except Exception as e:
+        report["errors"]["agg"] = str(e)
+    series: Dict[str, list] = {}
+    try:
+        series = parse_prometheus(
+            _fetch(url.rstrip("/") + "/metrics", timeout).decode(
+                "utf-8", "replace"))
+    except Exception as e:
+        report["errors"]["metrics"] = str(e)
+    report["slices"] = slice_freshness(agg_summary)
+    report["degradation"] = degradation_counters(series)
+    report["control_plane"] = control_plane_load(series, agg_summary)
+    try:
+        from horovod_tpu.trace import load_trace_events
+        from tools.trace_report import arrival_skew, straggler_ranking
+        events = load_trace_events(
+            _fetch(url.rstrip("/") + "/trace", timeout).decode(
+                "utf-8", "replace"))
+        ranking = straggler_ranking(arrival_skew(events))
+        report["stragglers"] = ranking[:5]
+        report["trace_events"] = len(events)
+    except Exception as e:
+        report["errors"]["trace"] = str(e)
+        report["stragglers"] = []
+    return report
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _fmt_age(age) -> str:
+    return "never" if age is None else f"{age:.1f}s ago"
+
+
+def render(report: dict) -> str:
+    lines = [f"cluster health @ {report['url']}"]
+    for endpoint, err in sorted(report.get("errors", {}).items()):
+        lines.append(f"  !! {endpoint} endpoint unavailable: {err}")
+    slices = report.get("slices", {})
+    lines.append("")
+    if slices:
+        lines.append("per-slice telemetry freshness:")
+        for k, ent in slices.items():
+            ages = "  ".join(
+                f"{s}={_fmt_age(a)}"
+                for s, a in sorted(ent["rollup_age"].items()))
+            lines.append(f"  slice {k:<3} agg={ent['addr']}  "
+                         f"ranks={ent['ranks']}  {ages}")
+    else:
+        lines.append("per-slice telemetry: no aggregators registered "
+                     "(flat topology or HOROVOD_TPU_AGG_ENABLE=0) — "
+                     "publishes go direct to the root")
+    stragglers = report.get("stragglers", [])
+    lines.append("")
+    if stragglers:
+        lines.append("top stragglers (last arrival at correlated "
+                     "collectives):")
+        for acc in stragglers:
+            lines.append(f"  rank {acc['rank']:<4} "
+                         f"last {acc['last_count']}x  "
+                         f"mean lateness {acc['mean_late_us']:.0f} us")
+    else:
+        lines.append("stragglers: none detected")
+    deg = report.get("degradation", {})
+    lines.append("")
+    lines.append("degradation counters (zero = healthy):")
+    fb = deg.get("agg_fallbacks", {})
+    by_stream = " ".join(f"{s}={v:.0f}" for s, v
+                         in sorted(fb.get("by_stream", {}).items()))
+    lines.append(f"  aggregator fallbacks: {fb.get('total', 0):.0f}"
+                 + (f"  ({by_stream})" if by_stream else ""))
+    shed = deg.get("shed_bytes", {})
+    lines.append(f"  shed telemetry bytes: {shed.get('total', 0):.0f}")
+    for key, label in (("kv_failovers", "kv failovers"),
+                       ("kv_breaker_trips", "kv breaker trips"),
+                       ("kv_backpressure", "kv backpressure hits"),
+                       ("kv_gave_up", "kv gave-up publishes"),
+                       ("kv_acked_writes_lost", "acked writes lost"),
+                       ("watchdog_escalations", "watchdog escalations")):
+        lines.append(f"  {label}: {deg.get(key, 0):.0f}")
+    cp = report.get("control_plane", {})
+    lines.append("")
+    lines.append("control-plane load at the root:")
+    per_step = cp.get("requests_per_step")
+    lines.append(f"  kv requests: {cp.get('total_requests', 0):.0f} total"
+                 + (f", {per_step} per step" if per_step is not None
+                    else " (no steps recorded yet)"))
+    scopes = cp.get("requests_by_scope", {})
+    if scopes:
+        row = "  ".join(f"{s}={v:.0f}" for s, v in sorted(scopes.items()))
+        lines.append(f"  by scope: {row}")
+    verbs = cp.get("requests_by_verb", {})
+    if verbs:
+        row = "  ".join(f"{v}={n:.0f}" for v, n in sorted(verbs.items()))
+        lines.append(f"  by verb: {row}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        description="Cluster health report over the root KV server's "
+                    "/agg, /metrics and /trace endpoints")
+    p.add_argument("--url", required=True,
+                   help="root server base URL, e.g. http://host:port")
+    p.add_argument("--timeout", type=float, default=10.0,
+                   help="per-endpoint fetch timeout (seconds)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the report as JSON")
+    args = p.parse_args(argv)
+    report = assemble(args.url, timeout=args.timeout)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
