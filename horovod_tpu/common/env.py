@@ -261,6 +261,18 @@ HOROVOD_KV_LEASE_INTERVAL = "HOROVOD_KV_LEASE_INTERVAL"
 HOROVOD_KV_ACK_REPLICAS = "HOROVOD_KV_ACK_REPLICAS"
 HOROVOD_KV_JOURNAL_MAX = "HOROVOD_KV_JOURNAL_MAX"
 HOROVOD_KV_SCOPE_BUDGET_BYTES = "HOROVOD_KV_SCOPE_BUDGET_BYTES"
+# survivable elastic driver (ISSUE 19, elastic/failover.py): JOURNAL
+# gates the driver-state journal (world versions, strikes, host deltas,
+# results — replicated through the "driver" KV scope so a standby can
+# reconstruct the driver after a crash); LEASE_TIMEOUT is how stale the
+# driver's journaled lease heartbeat may be before a standby considers
+# the driver dead and promotes; LEASE_INTERVAL paces that heartbeat.
+# Distinct from HOROVOD_KV_LEASE_* (the replication tier's own lease):
+# the KV lease elects a new PRIMARY REPLICA, the driver lease elects a
+# new ELASTIC DRIVER on top of it. All resolved once at init (divcheck).
+HOROVOD_TPU_DRIVER_JOURNAL = "HOROVOD_TPU_DRIVER_JOURNAL"
+HOROVOD_TPU_DRIVER_LEASE_TIMEOUT = "HOROVOD_TPU_DRIVER_LEASE_TIMEOUT"
+HOROVOD_TPU_DRIVER_LEASE_INTERVAL = "HOROVOD_TPU_DRIVER_LEASE_INTERVAL"
 # hierarchical telemetry fabric (ISSUE 18, runner/aggregator.py): AGG_ENABLE
 # turns on the per-slice aggregator tier — each slice's lowest-rank worker
 # hosts a SliceAggregator that receives slice-local metrics/trace/stall

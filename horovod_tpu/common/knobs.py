@@ -458,6 +458,24 @@ KNOB_SPECS: Dict[str, dict] = {
         "type": "int", "default": "4",
         "help": "Slot failure strikes before the host is blacklisted "
                 "for good."},
+    "HOROVOD_TPU_DRIVER_JOURNAL": {
+        "type": "bool", "default": "1",
+        "help": "Journal every elastic-driver state transition through "
+                "the replicated 'driver' KV scope so a standby can "
+                "reconstruct the driver after a crash (elastic/"
+                "failover.py). On by default; only effective when the "
+                "rendezvous server is replication-enabled."},
+    "HOROVOD_TPU_DRIVER_LEASE_TIMEOUT": {
+        "type": "float", "default": "2.0",
+        "help": "Seconds the driver's journaled lease heartbeat may go "
+                "stale before a standby considers the driver dead and "
+                "promotes. Distinct from HOROVOD_KV_LEASE_TIMEOUT: that "
+                "elects a new primary replica, this elects a new elastic "
+                "driver on top of it."},
+    "HOROVOD_TPU_DRIVER_LEASE_INTERVAL": {
+        "type": "float", "default": "0.5",
+        "help": "Seconds between driver lease heartbeats written to the "
+                "journal scope (paced by the discovery loop)."},
     # -- attention / Pallas kernels -----------------------------------------
     "HOROVOD_SPLASH": {
         "type": "choice", "default": "1",

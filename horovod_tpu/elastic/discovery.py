@@ -15,11 +15,21 @@ from __future__ import annotations
 import logging
 import subprocess
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
+from ..common.retry import retrying
+from ..faults import DROP, failpoint
+from ..metrics import registry as metrics_registry
 from ..runner.hosts import HostInfo
 
 _LOG = logging.getLogger("horovod_tpu.elastic")
+
+# Discovery-probe retry schedule (ISSUE 19 hardening): a flaky discovery
+# script gets a few bounded-backoff attempts before the manager falls
+# back to its last-known-good snapshot.
+DISCOVERY_RETRY_ATTEMPTS = 3
+DISCOVERY_RETRY_BASE_DELAY = 0.1
+DISCOVERY_RETRY_MAX_DELAY = 1.0
 
 
 class HostUpdateResult:
@@ -98,8 +108,36 @@ class HostManager:
     # -- membership ---------------------------------------------------------
 
     def update_available_hosts(self) -> int:
-        """Poll discovery; returns a HostUpdateResult bitmask."""
-        found = self._discovery.find_available_hosts_and_slots()
+        """Poll discovery; returns a HostUpdateResult bitmask.
+
+        Discovery hardening (ISSUE 19): a failing discovery
+        script/callable used to propagate — killing the driver's resume
+        path (``wait_for_available_slots`` calls this uncaught). Now the
+        probe gets bounded-backoff retries; on final failure the manager
+        serves its last-known-good snapshot (``NO_UPDATE``) with a
+        WARNING and ``hvd_tpu_discovery_failures_total``, and the driver
+        keeps running on stale-but-sane membership."""
+        def _probe():
+            if failpoint("driver.discovery") is DROP:
+                raise RuntimeError("injected: driver.discovery drop")
+            return self._discovery.find_available_hosts_and_slots()
+
+        try:
+            found = retrying(_probe, attempts=DISCOVERY_RETRY_ATTEMPTS,
+                             base_delay=DISCOVERY_RETRY_BASE_DELAY,
+                             max_delay=DISCOVERY_RETRY_MAX_DELAY,
+                             retry_on=(Exception,), op="discovery")
+        except Exception as e:
+            with self._lock:
+                stale = len(self._current)
+            metrics_registry().counter(
+                "hvd_tpu_discovery_failures_total").inc()
+            _LOG.warning(
+                "host discovery failed after %d attempts (%s); serving "
+                "the last-known-good membership snapshot (%d host(s)) — "
+                "STALE until discovery recovers",
+                DISCOVERY_RETRY_ATTEMPTS, e, stale)
+            return HostUpdateResult.NO_UPDATE
         with self._lock:
             usable = {h: s for h, s in found.items()
                       if h not in self._blacklist}
@@ -129,6 +167,25 @@ class HostManager:
     def available_slots(self) -> int:
         with self._lock:
             return sum(self._current.values())
+
+    def state(self) -> Tuple[Dict[str, int], List[str], set]:
+        """Consistent (current, order, blacklist) copy — the driver
+        journal's host-delta payload (ISSUE 19)."""
+        with self._lock:
+            return dict(self._current), list(self._order), \
+                set(self._blacklist)
+
+    def restore_state(self, current: Dict[str, int], order: List[str],
+                      blacklist):
+        """Install journaled host state (promotion path, ISSUE 19): the
+        promoted driver re-runs discovery against the dead driver's
+        membership view — seniority order and blacklist included, so
+        rank 0 stays on the longest-surviving host."""
+        with self._lock:
+            self._blacklist = set(blacklist)
+            self._current = {h: int(s) for h, s in current.items()
+                             if h not in self._blacklist}
+            self._order = [h for h in order if h in self._current]
 
     # -- blacklist ----------------------------------------------------------
 

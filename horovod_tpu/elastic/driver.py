@@ -68,6 +68,7 @@ class ElasticDriver:
         "_slot_strikes": "_lock",
         "_error_message": "_lock",
         "_world_version": "_lock",
+        "_last_notify": "_lock",
         "_m_events": "<internal>",
     }
 
@@ -90,6 +91,13 @@ class ElasticDriver:
         self._started_slots: set = set()           # (host, local_rank)
         self._world_version = 0
         self._pending_resume = False
+        # last membership notification pushed to workers while a resume
+        # was pending — restored on promotion so the new driver keeps
+        # re-pushing it (failover.py); (timestamp, update_res) or None
+        self._last_notify: Optional[Tuple[int, int]] = None
+        # driver-state journal (failover.DriverJournal) — None journals
+        # nothing; attach_journal() before start() enables replication
+        self._journal = None
         self._results: Dict[str, Tuple[object, int]] = {}
         # per-slot failure strikes: "host:local_rank" -> {count, last,
         # until} (monotonic). until=inf means permanently excluded.
@@ -124,6 +132,85 @@ class ElasticDriver:
         """
         self._create_worker_fn = create_worker_fn
         self._activate_workers(np)
+        self._discovery_thread.start()
+
+    def attach_journal(self, journal):
+        """Enable driver-state journaling (failover.DriverJournal). Call
+        before ``start()``/``start_restored()`` — every subsequent world
+        bump, strike, host delta, pending flag, and worker result commits
+        to the replicated ``driver/`` scope before the driver acts on
+        it."""
+        self._journal = journal
+
+    @classmethod
+    def restore_from_ledger(cls, ledger, rendezvous, discovery,
+                            min_np: int, max_np: Optional[int] = None,
+                            timeout: Optional[float] = None,
+                            reset_limit: Optional[int] = None,
+                            verbose: bool = False, journal=None
+                            ) -> "ElasticDriver":
+        """Rebuild a driver from a replayed journal (failover.py
+        promotion path): world version, assignments, started slots,
+        results, strikes, and discovered-host state all resume where the
+        dead driver journaled them. The restored driver is inert until
+        ``start_restored``."""
+        d = cls(rendezvous, discovery, min_np=min_np, max_np=max_np,
+                timeout=timeout, reset_limit=reset_limit, verbose=verbose)
+        d._journal = journal
+        d._host_manager.restore_state(ledger.hosts, ledger.order,
+                                      ledger.blacklist)
+        now = time.monotonic()
+        with d._lock:
+            d._world_version = ledger.version
+            d._assignments = ledger.slot_infos()
+            d._started_slots = {(h, lr) for h, lr in ledger.started}
+            d._results = {k: (None, code)
+                          for k, code in ledger.results.items()}
+            # finite backoffs from the dead driver's clock are not
+            # portable across processes — restore counts (and permanent
+            # exclusions), let fresh failures re-earn their backoff
+            d._slot_strikes = {
+                key: {"count": ent["count"], "last": now,
+                      "until": float("inf") if ent["permanent"] else 0.0}
+                for key, ent in ledger.strikes.items()}
+            d._pending_resume = ledger.pending
+            d._last_notify = ledger.notify
+        d._registry.reset(list(ledger.expected))
+        return d
+
+    def start_restored(self, create_worker_fn: Callable[[SlotInfo], None]):
+        """Begin serving a restored world (promotion path): no fresh
+        activation — assignments are already published state. Seeds the
+        registry with journaled worker results (their processes died
+        with the old driver and will never re-report), re-pushes the
+        journaled membership notification when a resize was in flight,
+        and starts discovery against the restored host state."""
+        self._create_worker_fn = create_worker_fn
+        with self._lock:
+            version = self._world_version
+            pending = self._pending_resume
+            last_notify = self._last_notify
+            results = dict(self._results)
+            expected = {f"{s.hostname}:{s.local_rank}"
+                        for s in self._assignments}
+        self._m_world_version.set(version)
+        self._m_events.append(
+            "driver_promoted",
+            f"v{version} pending={pending} workers={len(expected)}")
+        for key, (_, code) in results.items():
+            if key not in expected:
+                continue
+            host, _, lr = key.rpartition(":")
+            if code == 0:
+                self._registry.record_success(host, int(lr))
+            else:
+                self._registry.record_failure(host, int(lr))
+        if pending and last_notify is not None:
+            # live workers may have heard this from the dead driver
+            # already (same timestamp ⇒ listeners dedupe); workers that
+            # registered since must hear it from us
+            self._notify_workers_host_changes(*last_notify)
+        self._maybe_finish_on_success()
         self._discovery_thread.start()
 
     def stop(self, error_message: Optional[str] = None):
@@ -189,7 +276,15 @@ class ElasticDriver:
                           bool(self._assignments))
                 expected = len(self._assignments)
             if formed and self._registry.count(READY) >= expected:
-                return True
+                # the registry count ran OFF the driver lock: a resize
+                # landing in that window could have satisfied the count
+                # with the PRIOR world's readiness — re-check the world
+                # is still the one we counted (ISSUE 19 race fix)
+                with self._lock:
+                    if (self._world_version >= version and
+                            not self._pending_resume and
+                            len(self._assignments) == expected):
+                        return True
             time.sleep(0.05)
         return False
 
@@ -216,12 +311,27 @@ class ElasticDriver:
         is served as 'pending'.
         """
         with self._lock:
-            if self._pending_resume or self._world_version <= min_version:
-                return "pending", None, self._world_version
+            version = self._world_version
+            if self._pending_resume or version <= min_version:
+                return "pending", None, version
+            found = None
             for s in self._assignments:
                 if s.hostname == host and s.local_rank == local_rank:
-                    return "assigned", s, self._world_version
-            return "removed", None, self._world_version
+                    found = s
+                    break
+            # Re-read under the SAME lock hold (ISSUE 19 race fix): the
+            # lock is an RLock, so a reentrant resume on this thread (a
+            # registry barrier fired by the record_ready that preceded
+            # this lookup) can swap _assignments/_world_version between
+            # the version check above and the scan — handing the caller
+            # a slot from the PRIOR world. A version mismatch (or a
+            # freshly-pending resume) is served as 'pending': the worker
+            # long-polls and reads the new world's plan instead.
+            if self._world_version != version or self._pending_resume:
+                return "pending", None, self._world_version
+            if found is not None:
+                return "assigned", found, version
+            return "removed", None, version
 
     # -- membership / activation --------------------------------------------
 
@@ -307,6 +417,24 @@ class ElasticDriver:
             self._world_version += 1
             self._assignments = assignments
             self._pending_resume = False
+            self._last_notify = None
+            if self._journal is not None:
+                # commit the world bump to the replicated journal BEFORE
+                # publishing it: a standby that promotes mid-activation
+                # must resume THIS version, never re-serve the old one.
+                # The host snapshot rides along: the initial membership
+                # is consumed by wait_for_available_slots before the
+                # discovery thread (the usual "hosts" journaler) exists,
+                # and a standby must never replay an empty host view.
+                current, order, blacklist = self._host_manager.state()
+                self._journal.append("hosts", current=current, order=order,
+                                     blacklist=sorted(blacklist))
+                self._journal.append(
+                    "world", version=self._world_version,
+                    assignments=[s.to_response_string()
+                                 for s in assignments],
+                    expected=[f"{s.hostname}:{s.local_rank}"
+                              for s in assignments])
             self._rendezvous.init(assignments)
             # a new world re-numbers ranks: published trace segments from
             # the previous world would merge two different processes under
@@ -331,6 +459,10 @@ class ElasticDriver:
                 # a restarted slot's result belongs to a previous world —
                 # it must not satisfy this world's completion check
                 self._results.pop(f"{s.hostname}:{s.local_rank}", None)
+            if pending and self._journal is not None:
+                self._journal.append(
+                    "started", slots=[[s.hostname, s.local_rank]
+                                      for s in pending])
             _LOG.info("world v%d: %d workers (%d newly started)",
                       self._world_version, len(assignments), len(pending))
             self._m_world_version.set(self._world_version)
@@ -357,8 +489,12 @@ class ElasticDriver:
     # -- discovery thread ---------------------------------------------------
 
     def _discover_hosts(self):
-        last_notify = None  # (timestamp, update_res) of the pending change
         while not self._shutdown.is_set():
+            # lockcheck: ignore[_journal is assigned once (attach_journal/restore_from_ledger) before the discovery thread exists; DriverJournal serializes its own writes]
+            if self._journal is not None:
+                # liveness lease for the standby's election restriction
+                # (failover.DriverStandby defers while this stays fresh)
+                self._journal.heartbeat()
             try:
                 failpoint("elastic.discovery")
                 res = self._host_manager.update_available_hosts()
@@ -366,20 +502,38 @@ class ElasticDriver:
                 _LOG.warning("host discovery failed: %s", e)
                 res = HostUpdateResult.NO_UPDATE
             if res != HostUpdateResult.NO_UPDATE and \
+                    self._journal is not None:
+                current, order, blacklist = self._host_manager.state()
+                self._journal.append("hosts", current=current, order=order,
+                                     blacklist=sorted(blacklist))
+            if res != HostUpdateResult.NO_UPDATE and \
                     self._membership_matters(res):
+                notify = (int(time.time() * 1e6), res)
                 with self._lock:
                     self._pending_resume = True
+                    self._last_notify = notify
+                if self._journal is not None:
+                    # pending committed BEFORE workers hear of it: a
+                    # promotion landing inside this resize must re-push
+                    # the same (timestamp, res) so listeners dedupe
+                    self._journal.append("pending", pending=True,
+                                         timestamp=notify[0],
+                                         update_res=notify[1])
                 self._registry.invalidate_ready()
-                last_notify = (int(time.time() * 1e6), res)
-                self._notify_workers_host_changes(*last_notify)
-            elif self.resume_needed() and last_notify is not None:
-                # Keep re-sending while the resume is pending: a worker that
-                # registered its notification address *after* the change was
-                # first pushed (slow startup) would otherwise never hear of
-                # it and the old world would run to completion under a
-                # pending resume. Same timestamp ⇒ already-notified
-                # listeners dedupe (state.py on_hosts_updated).
-                self._notify_workers_host_changes(*last_notify)
+                self._notify_workers_host_changes(*notify)
+            else:
+                with self._lock:
+                    notify = self._last_notify if self._pending_resume \
+                        else None
+                if notify is not None:
+                    # Keep re-sending while the resume is pending: a
+                    # worker that registered its notification address
+                    # *after* the change was first pushed (slow startup)
+                    # would otherwise never hear of it and the old world
+                    # would run to completion under a pending resume.
+                    # Same timestamp ⇒ already-notified listeners dedupe
+                    # (state.py on_hosts_updated).
+                    self._notify_workers_host_changes(*notify)
             self._shutdown.wait(DISCOVER_HOSTS_FREQUENCY_SECS)
 
     def _membership_matters(self, res: int) -> bool:
@@ -448,6 +602,13 @@ class ElasticDriver:
         # off-lock-access regression, tests/test_race_regressions.py)
         with self._lock:
             self._results[key] = (result, exit_code)
+            if self._journal is not None:
+                # the exit commits before any recovery acts on it: a
+                # promoted standby must know which workers already
+                # finished (their monitors died with this process and
+                # will never re-report)
+                self._journal.append("result", key=key,
+                                     exit_code=exit_code)
         self._m_events.append("rank_leave", f"{key} exit={exit_code}")
         if exit_code == 0:
             with self._lock:
@@ -465,6 +626,8 @@ class ElasticDriver:
                                for s in self._assignments)
                 if in_world:
                     self._pending_resume = True
+                    if self._journal is not None:
+                        self._journal.append("pending", pending=True)
                     self._record_slot_strike(key)
             if in_world:
                 # READY states recorded when the (now dying) world was
@@ -484,6 +647,8 @@ class ElasticDriver:
             if not self._host_still_alive(host):
                 self._host_manager.blacklist(host)
                 self._m_events.append("blacklist", host)
+                if self._journal is not None:
+                    self._journal.append("blacklist", host=host)
             self._registry.record_failure(host, local_rank)
 
     # requires: _lock
@@ -504,6 +669,13 @@ class ElasticDriver:
             ent = {"count": 0, "last": now, "until": 0.0}
         ent["count"] += 1
         ent["last"] = now
+        if self._journal is not None:
+            # the strike commits before the suspension/blacklist acts:
+            # a promoted standby restores the count so a flapping slot
+            # cannot reset its strikes by killing the driver
+            self._journal.append(
+                "strike", key=key, count=ent["count"],
+                permanent=ent["count"] >= self._slot_failure_limit)
         if ent["count"] >= self._slot_failure_limit:
             ent["until"] = float("inf")
             host = key.rsplit(":", 1)[0]
@@ -511,6 +683,8 @@ class ElasticDriver:
                        "(HOROVOD_ELASTIC_SLOT_FAILURE_LIMIT=%d)",
                        key, ent["count"], host, self._slot_failure_limit)
             self._host_manager.blacklist(host)
+            if self._journal is not None:
+                self._journal.append("blacklist", host=host)
             self._m_events.append("slot_excluded",
                                   f"{key} strikes={ent['count']} "
                                   f"host_blacklisted={host}")

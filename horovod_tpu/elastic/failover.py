@@ -1,0 +1,463 @@
+"""Driver-state replication and failover (ISSUE 19).
+
+PR 12 made the KV *store* survivable (``runner/replication.py``); the
+elastic driver's in-process state — world version, slot assignments,
+strikes, discovered hosts, pending-resume flags, worker results — stayed
+colocated with the primary and died with it. This module closes that
+fault domain:
+
+- :class:`DriverJournal` records every driver state transition as
+  journaled writes through the PR 12 ``ReplicaCoordinator`` fabric: a
+  dedicated ``driver/`` KV scope, quorum-acked on the epoch-fenced
+  replication stream. ``ElasticDriver._activate_workers``,
+  ``_record_slot_strike``, and ``record_worker_exit`` commit their
+  transitions here before (or atomically with) acting on them, so a
+  standby's local store always holds a replayable prefix of driver
+  history.
+- :class:`DriverStandby` runs next to a standby KV replica, tails the
+  journal out of its local replicated store, and on lease expiry runs
+  the election restriction — defer to a reachable live driver (fresh
+  journal lease), only then promote: replay the journal into a restored
+  :class:`~.driver.ElasticDriver`, re-bind the rendezvous endpoints
+  (``server.set_driver``), re-run discovery against journaled host
+  state, and resume any in-flight resize at the journaled world version.
+  Workers' ``get_slot_state`` long-polls land on the promoted driver via
+  the PR 12 ``Endpoints`` failover — no elastic restore, no fleet
+  restart.
+
+Lock order: ``driver._lock -> journal._lock -> coordinator._lock ->
+server._lock`` (journal writes may run under the driver lock, exactly
+like the replicated ``rendezvous.init`` clears already do; nothing takes
+the driver lock from under a journal/coordinator/server lock).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..common.env import (HOROVOD_TPU_DRIVER_JOURNAL,
+                          HOROVOD_TPU_DRIVER_LEASE_INTERVAL,
+                          HOROVOD_TPU_DRIVER_LEASE_TIMEOUT, _get_bool,
+                          _get_float)
+from ..faults import DROP, failpoint
+from ..metrics import registry as metrics_registry
+from ..runner.hosts import SlotInfo
+
+_LOG = logging.getLogger("horovod_tpu.elastic")
+
+# Dedicated KV scope for driver state (PR 12 fabric): journal entries
+# under e<seq>, the head pointer under "head", the liveness lease under
+# "lease". Standbys read it straight out of their local replicated store.
+SCOPE_DRIVER = "driver"
+KEY_HEAD = "head"
+KEY_LEASE = "lease"
+
+DEFAULT_DRIVER_LEASE_TIMEOUT = 2.0
+DEFAULT_DRIVER_LEASE_INTERVAL = 0.5
+
+
+class DriverJournal:
+    """Append-only driver-transition log in the replicated ``driver/``
+    scope.
+
+    Entry kinds (JSON, one KV key ``e<seq>`` each; replayed in seq
+    order by :meth:`replay`):
+
+    - ``world``:    a world-version bump with its full slot assignments
+                    and expected worker set (clears any pending flag)
+    - ``started``:  slots the driver launched processes for
+    - ``hosts``:    discovered-host delta — the full membership
+                    snapshot, seniority order, and blacklist
+    - ``pending``:  the pending-resume flag flipped on (with the
+                    notify timestamp/result when membership-driven)
+    - ``strike``:   a slot failure strike (count + permanent flag)
+    - ``blacklist``: a host blacklisted by the liveness probe
+    - ``result``:   a worker exit (key + exit code)
+
+    Writes ride ``ReplicaCoordinator.client_write`` when the rendezvous
+    server is replicated (quorum-acked on the epoch-fenced stream) and
+    fall back to the local store core otherwise (unit tests, standalone
+    drivers — replay still works from a local snapshot). A refused or
+    failed journal write is a WARNING, never fatal: availability of the
+    running world outranks strict journaling, and the gap is visible as
+    a stale journal head on the standby.
+    """
+
+    _GUARDED_BY = {"_seq": "_lock", "_lease_last": "_lock",
+                   "_lease_count": "_lock"}
+
+    def __init__(self, server, seq_start: int = 1):
+        self._server = server
+        self._lock = threading.Lock()
+        self._seq = seq_start - 1
+        self._lease_last = 0.0
+        self._lease_count = 0
+        self._enabled = _get_bool(HOROVOD_TPU_DRIVER_JOURNAL, True)
+        self._lease_interval = _get_float(HOROVOD_TPU_DRIVER_LEASE_INTERVAL,
+                                          DEFAULT_DRIVER_LEASE_INTERVAL)
+        self._m_writes = metrics_registry().counter(
+            "hvd_tpu_driver_journal_writes_total")
+
+    # -- write path ---------------------------------------------------------
+
+    def _write(self, key: str, value: bytes) -> bool:
+        repl = getattr(self._server, "replication", None)
+        if repl is not None:
+            from ..runner.http_server import OK, _normalize
+            code = _normalize(repl.client_write("put", SCOPE_DRIVER, key,
+                                                value))[0]
+            if code != OK:
+                _LOG.warning(
+                    "driver journal write %s refused by the replication "
+                    "tier (HTTP %d): the standby's driver state is stale "
+                    "until the next successful append", key, code)
+                return False
+            return True
+        self._server._store_apply("put", SCOPE_DRIVER, key, value)
+        return True
+
+    def append(self, kind: str, **fields) -> bool:
+        """Journal one transition; returns whether the write landed."""
+        if not self._enabled:
+            return False
+        if failpoint("driver.journal") is DROP:
+            _LOG.warning("driver journal append %r dropped (fault "
+                         "injection): standby state will lag", kind)
+            return False
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            entry = dict(fields)
+            entry["kind"] = kind
+            entry["seq"] = seq
+            payload = json.dumps(entry).encode()
+            # the head pointer moves with the entry under the journal
+            # lock so concurrent appends stay seq-ordered in the store
+            try:
+                ok = self._write(f"e{seq:08d}", payload) and \
+                    self._write(KEY_HEAD, str(seq).encode())
+            except Exception as e:
+                _LOG.warning("driver journal append %r failed: %s "
+                             "(continuing; standby state will lag)",
+                             kind, e)
+                return False
+        self._m_writes.inc(kind=kind)
+        return ok
+
+    def heartbeat(self):
+        """Refresh the driver liveness lease (throttled to the lease
+        interval). Standbys defer promotion while this key keeps
+        changing — the "reachable live driver" election restriction."""
+        if not self._enabled:
+            return
+        with self._lock:
+            now = time.monotonic()
+            if now - self._lease_last < self._lease_interval:
+                return
+            self._lease_last = now
+            self._lease_count += 1
+            count = self._lease_count
+        try:
+            self._write(KEY_LEASE, str(count).encode())
+        except Exception as e:
+            _LOG.debug("driver lease heartbeat failed: %s", e)
+
+    def head(self) -> int:
+        with self._lock:
+            return self._seq
+
+    # -- replay -------------------------------------------------------------
+
+    @staticmethod
+    def replay(driver_scope: Dict[str, bytes]) -> "DriverLedger":
+        """Rebuild driver state from a ``driver/`` scope snapshot (the
+        standby's local replicated store). Unparseable entries are
+        skipped loudly — a torn tail entry must not block promotion."""
+        entries = []
+        for key, raw in driver_scope.items():
+            if not key.startswith("e"):
+                continue
+            try:
+                entries.append(json.loads(raw))
+            except Exception:
+                _LOG.warning("unparseable driver journal entry %s; "
+                             "skipping", key)
+        entries.sort(key=lambda e: e.get("seq", 0))
+        led = DriverLedger()
+        for e in entries:
+            led.apply(e)
+        head_raw = driver_scope.get(KEY_HEAD)
+        if head_raw is not None:
+            try:
+                led.head = max(led.head, int(head_raw))
+            except ValueError:
+                pass
+        return led
+
+
+class DriverLedger:
+    """The replayed driver state a promotion restores from (also the
+    standby's shadow-state source — tests compare it bitwise against a
+    live driver's HostManager/registry view)."""
+
+    def __init__(self):
+        self.head = 0
+        self.version = 0
+        self.assignments: List[str] = []       # SlotInfo response strings
+        self.expected: List[str] = []
+        self.started: List[List] = []          # [host, local_rank]
+        self.results: Dict[str, int] = {}
+        self.strikes: Dict[str, dict] = {}     # key -> {count, permanent}
+        self.hosts: Dict[str, int] = {}
+        self.order: List[str] = []
+        self.blacklist: List[str] = []
+        self.pending = False
+        self.notify = None                     # (timestamp, update_res)
+
+    def apply(self, e: dict):
+        kind = e.get("kind")
+        self.head = max(self.head, int(e.get("seq", 0)))
+        if kind == "world":
+            self.version = int(e["version"])
+            self.assignments = list(e["assignments"])
+            self.expected = list(e["expected"])
+            self.pending = False
+            self.notify = None
+            # results recorded for the previous world stay: the driver
+            # pops only restarted slots' results, mirrored by "started"
+        elif kind == "started":
+            for slot in e["slots"]:
+                if slot not in self.started:
+                    self.started.append(slot)
+                self.results.pop(f"{slot[0]}:{slot[1]}", None)
+        elif kind == "hosts":
+            self.hosts = dict(e["current"])
+            self.order = list(e["order"])
+            self.blacklist = list(e["blacklist"])
+        elif kind == "pending":
+            self.pending = bool(e.get("pending", True))
+            ts, res = e.get("timestamp"), e.get("update_res")
+            if ts is not None and res is not None:
+                self.notify = (int(ts), int(res))
+        elif kind == "strike":
+            self.strikes[e["key"]] = {"count": int(e["count"]),
+                                      "permanent": bool(e["permanent"])}
+        elif kind == "blacklist":
+            h = e["host"]
+            if h not in self.blacklist:
+                self.blacklist.append(h)
+            self.hosts.pop(h, None)
+            self.order = [x for x in self.order if x != h]
+        elif kind == "result":
+            key = e["key"]
+            self.results[key] = int(e["exit_code"])
+            if int(e["exit_code"]) == 0:
+                self.strikes.pop(key, None)
+            slot = key.rsplit(":", 1)
+            pair = [slot[0], int(slot[1])]
+            if pair in self.started:
+                self.started.remove(pair)
+        else:
+            _LOG.warning("unknown driver journal entry kind %r; skipping",
+                         kind)
+
+    def slot_infos(self) -> List[SlotInfo]:
+        return [SlotInfo.from_response_string(s) for s in self.assignments]
+
+
+class DriverStandby:
+    """Shadow driver host: tails the journal and promotes on lease
+    expiry.
+
+    Colocated with a standby KV replica (an
+    :class:`~.rendezvous.ElasticRendezvousServer` with replication
+    enabled): the PR 12 fabric delivers every journaled driver
+    transition into this process's local store, so "tailing" is a local
+    snapshot read — no extra network load on the primary.
+
+    Promotion trigger: the local ``ReplicaCoordinator`` winning the KV
+    election (its restriction — defer to a live primary at the current
+    epoch, pull the journal tail from a more-applied peer — has already
+    run), *plus* the driver-level restriction here: defer while the
+    journaled driver lease is still fresh (a reachable live driver is
+    still journaling). Only then :meth:`promote` replays the journal,
+    restores an :class:`~.driver.ElasticDriver`, re-binds the rendezvous
+    (``set_driver``), and resumes any in-flight resize.
+    """
+
+    _GUARDED_BY = {
+        "_driver": "_lock",
+        "_lease_value": "_lock",
+        "_lease_changed": "_lock",
+        "_last_promotion_epoch": "_lock",
+    }
+
+    def __init__(self, server, discovery, min_np: int,
+                 max_np: Optional[int] = None,
+                 timeout: Optional[float] = None,
+                 reset_limit: Optional[int] = None,
+                 create_worker_fn: Optional[Callable] = None,
+                 verbose: bool = False):
+        self._server = server
+        self._discovery = discovery
+        self._min_np = min_np
+        self._max_np = max_np
+        self._timeout = timeout
+        self._reset_limit = reset_limit
+        self._create_worker_fn = create_worker_fn
+        self._verbose = verbose
+        self._lease_timeout = _get_float(HOROVOD_TPU_DRIVER_LEASE_TIMEOUT,
+                                         DEFAULT_DRIVER_LEASE_TIMEOUT)
+        self._lease_interval = _get_float(HOROVOD_TPU_DRIVER_LEASE_INTERVAL,
+                                          DEFAULT_DRIVER_LEASE_INTERVAL)
+        self._lock = threading.Lock()
+        self._driver = None
+        self._lease_value: Optional[bytes] = None
+        self._lease_changed = time.monotonic()
+        self._last_promotion_epoch = 0
+        self._stop_evt = threading.Event()
+        self._thread = threading.Thread(target=self._monitor,
+                                        name="driver-standby", daemon=True)
+        reg = metrics_registry()
+        self._m_promotions = reg.counter("hvd_tpu_driver_promotions_total")
+        self._m_failovers = reg.counter("hvd_tpu_driver_failovers_total")
+        self._m_recoveries = reg.counter("hvd_tpu_elastic_recoveries_total")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop_evt.set()
+        if self._thread.is_alive() and \
+                threading.current_thread() is not self._thread:
+            self._thread.join(timeout=5)
+        with self._lock:
+            driver = self._driver
+        if driver is not None:
+            driver.join()
+
+    @property
+    def driver(self):
+        with self._lock:
+            return self._driver
+
+    def last_promotion_epoch(self) -> int:
+        with self._lock:
+            return self._last_promotion_epoch
+
+    # -- journal tailing ----------------------------------------------------
+
+    def _driver_scope(self) -> Dict[str, bytes]:
+        return self._server.snapshot(SCOPE_DRIVER).get(SCOPE_DRIVER, {})
+
+    def journal_head(self) -> int:
+        """Highest journaled driver seq visible in the local store."""
+        raw = self._driver_scope().get(KEY_HEAD)
+        try:
+            return int(raw) if raw is not None else 0
+        except ValueError:
+            return 0
+
+    def shadow(self) -> DriverLedger:
+        """Replay the locally-replicated journal into a ledger — the
+        standby's shadow HostManager/registry view."""
+        return DriverJournal.replay(self._driver_scope())
+
+    def lag(self) -> int:
+        """KV replication lag in journal entries: what the primary
+        journaled minus what this replica applied (0 when caught up —
+        client_write acks only after standby apply, so this is nonzero
+        only under degraded quorum)."""
+        repl = self._server.replication
+        if repl is None:
+            return 0
+        st = repl.status()
+        return max(0, int(st["seq"]) - int(st["applied_seq"]))
+
+    # -- election restriction ----------------------------------------------
+
+    def _observe_lease(self):
+        raw = self._driver_scope().get(KEY_LEASE)
+        with self._lock:
+            if raw != self._lease_value:
+                self._lease_value = raw
+                self._lease_changed = time.monotonic()
+
+    def _lease_fresh(self) -> bool:
+        """A reachable live driver is still journaling: its lease key
+        changed within the driver lease timeout."""
+        self._observe_lease()
+        with self._lock:
+            if self._lease_value is None:
+                return False     # no driver ever journaled here
+            return (time.monotonic() - self._lease_changed) < \
+                self._lease_timeout
+
+    # -- promotion ----------------------------------------------------------
+
+    def _monitor(self):
+        while not self._stop_evt.is_set():
+            try:
+                self._observe_lease()
+                repl = self._server.replication
+                if repl is not None and repl.is_primary() and \
+                        self.driver is None:
+                    # the KV election already fenced the old epoch and
+                    # pulled the journal tail; the driver-level defer
+                    # below still yields to a live driver mid-handoff
+                    self.promote(reason="lease-expiry")
+            except Exception as e:
+                _LOG.warning("driver standby monitor error: %s", e)
+            self._stop_evt.wait(self._lease_interval)
+
+    def promote(self, reason: str = "manual"):
+        """Run the promotion: replay the journal, restore the driver,
+        re-bind the rendezvous, resume any in-flight resize. Returns the
+        promoted driver, or None when deferring to a live driver."""
+        failpoint("driver.promote")
+        with self._lock:
+            if self._driver is not None:
+                return self._driver
+        if self._lease_fresh():
+            _LOG.info("driver promotion deferred (%s): a live driver's "
+                      "journal lease is still fresh", reason)
+            return None
+        from .driver import ElasticDriver
+        ledger = self.shadow()
+        _LOG.warning(
+            "promoting standby to elastic driver (%s): journal head %d, "
+            "world v%d, %d assignment(s), pending_resume=%s", reason,
+            ledger.head, ledger.version, len(ledger.assignments),
+            ledger.pending)
+        journal = DriverJournal(self._server, seq_start=ledger.head + 1)
+        driver = ElasticDriver.restore_from_ledger(
+            ledger, self._server, self._discovery, min_np=self._min_np,
+            max_np=self._max_np, timeout=self._timeout,
+            reset_limit=self._reset_limit, verbose=self._verbose,
+            journal=journal)
+        # re-bind the rendezvous endpoints: workers' long-polls now land
+        # on a driver again (they failed over to this replica already)
+        self._server.set_driver(driver)
+        epoch = 0
+        repl = self._server.replication
+        if repl is not None:
+            epoch = int(repl.status().get("epoch", 0))
+        self._m_promotions.inc()
+        if reason != "manual":
+            self._m_failovers.inc()
+        if ledger.pending:
+            # the in-flight resize resumes on this driver — count it as
+            # an elastic recovery so the chaos acceptance can prove ONE
+            # driver failover and ZERO fleet restarts from one scrape
+            self._m_recoveries.inc(kind="driver_failover")
+        driver.start_restored(self._create_worker_fn)
+        with self._lock:
+            self._driver = driver
+            self._last_promotion_epoch = epoch
+        return driver

@@ -28,18 +28,27 @@ _LOG = logging.getLogger("horovod_tpu.elastic")
 
 def make_elastic_worker_env(slot: SlotInfo, rendezvous_addr: str,
                             rendezvous_port: int,
-                            base_env: Optional[Dict[str, str]] = None
+                            base_env: Optional[Dict[str, str]] = None,
+                            rendezvous_endpoints: Optional[str] = None
                             ) -> Dict[str, str]:
     """Worker env for elastic mode: identity is (hostname, local_rank); the
     global rank/size are *not* pinned — the worker re-fetches its SlotInfo
-    from the rendezvous on every (re-)init."""
+    from the rendezvous on every (re-)init.
+
+    ``rendezvous_endpoints`` (ISSUE 19): a replica-set comma spec
+    (``"h1:p1,h2:p2"``) advertised INSTEAD of the single address when the
+    control plane is replicated — every worker KV consumer resolves it
+    onto the shared Endpoints failover set (sticky primary, epoch-aware
+    redirects, circuit breakers), so a driver failover never strands a
+    worker on a dead address."""
     env = dict(base_env if base_env is not None else os.environ)
     env.update({
         env_mod.HOROVOD_ELASTIC: "1",
         env_mod.HOROVOD_HOSTNAME: slot.hostname,
         env_mod.HOROVOD_LOCAL_RANK: str(slot.local_rank),
         env_mod.HOROVOD_TPU_COORDINATOR: COORDINATOR_VIA_RENDEZVOUS,
-        env_mod.HOROVOD_GLOO_RENDEZVOUS_ADDR: rendezvous_addr,
+        env_mod.HOROVOD_GLOO_RENDEZVOUS_ADDR:
+            rendezvous_endpoints or rendezvous_addr,
         env_mod.HOROVOD_GLOO_RENDEZVOUS_PORT: str(rendezvous_port),
     })
     return env

@@ -29,6 +29,13 @@ class ElasticRendezvousServer(RendezvousServer):
     """
 
     SCOPE_WORKER_ADDRS = "worker_addresses"
+    # Worker result self-reports (ISSUE 19): the launcher's process
+    # monitors die with the driver process, so across a driver failover
+    # nobody would ever record a surviving worker's exit — workers
+    # report their own completion here (PUT worker_results/<host>:<lr>
+    # = exit code, riding the Endpoints failover set) and the attached
+    # driver records it through the same journaled accounting path.
+    SCOPE_WORKER_RESULTS = "worker_results"
 
     def __init__(self, addr=("0.0.0.0", 0)):
         super().__init__(addr)
@@ -115,6 +122,25 @@ class ElasticRendezvousServer(RendezvousServer):
                         INVALID_SLOT_INFO.to_response_string()).encode()
             return (f"{version}|" + slot.to_response_string()).encode()
         return super().handle_get(scope, key, handler)
+
+    def handle_put(self, scope: str, key: str, value: bytes, handler):
+        if scope == self.SCOPE_WORKER_RESULTS and self._driver is not None:
+            try:
+                host, _, lr = key.rpartition(":")
+                local_rank = int(lr)
+                exit_code = int(value.decode().strip() or "0")
+            except (ValueError, UnicodeDecodeError) as e:
+                _LOG.warning("rejecting malformed worker result %r=%r "
+                             "(%s)", key, value[:64], e)
+                return 400
+            if not host:
+                return 400
+            # feeds the journaled exit accounting (results table,
+            # completion check) — idempotent with the process monitor's
+            # record_worker_exit when both observe the same exit
+            self._driver.record_worker_exit(host, local_rank, exit_code)
+            return OK
+        return super().handle_put(scope, key, value, handler)
 
     def worker_addresses(self) -> Dict[str, str]:
         """rank → ``host:port`` of each worker's notification service."""

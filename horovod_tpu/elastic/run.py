@@ -24,7 +24,7 @@ import os
 from ..common.exceptions import (HorovodInternalError, HostsUpdatedInterrupt,
                                  WorkerRemovedError)
 from ..metrics import registry as metrics_registry
-from .worker import notification_manager
+from .worker import notification_manager, report_worker_result
 
 _LOG = logging.getLogger("horovod_tpu.elastic")
 
@@ -129,7 +129,15 @@ def run_fn(func, reset):
                     state.sync()
                 commits_before = getattr(state, "_commit_count", 0)
                 try:
-                    return func(state, *args, **kwargs)
+                    ret = func(state, *args, **kwargs)
+                    # Self-report the clean completion (ISSUE 19): the
+                    # launcher-side process monitor that normally records
+                    # this exit dies with the driver process, so across a
+                    # driver failover this PUT is how the promoted driver
+                    # learns the worker finished. Best-effort, rides the
+                    # Endpoints failover set.
+                    report_worker_result(0)
+                    return ret
                 except _recoverable_errors() as e:
                     if isinstance(e, HorovodInternalError):
                         raw_failures = 0  # definitely a collective failure

@@ -24,13 +24,14 @@ from ..common.retry import retrying
 from ..faults import failpoint
 from ..metrics import registry as metrics_registry
 from ..runner.http_server import KVStoreServer
-from ..runner.http_client import put_data_into_kvstore
+from ..runner.http_client import put_data_into_kvstore, resolve_endpoints
 
 _LOG = logging.getLogger("horovod_tpu.elastic")
 
 SCOPE_NOTIFY = "notify"
 KEY_HOSTS_UPDATED = "hosts_updated"
 SCOPE_WORKER_ADDRS = "worker_addresses"
+SCOPE_WORKER_RESULTS = "worker_results"
 
 
 class WorkerNotificationService(KVStoreServer):
@@ -125,7 +126,15 @@ class WorkerNotificationManager:
             self._service.start()
             host = hostname or os.environ.get(env_mod.HOROVOD_HOSTNAME) or \
                 socket.gethostname()
-            self._rdv = (addr, port)
+            # Every driver RPC rides the PR 12 Endpoints set (ISSUE 19):
+            # the rendezvous addr may be a replica-set comma spec — the
+            # shared Endpoints instance gives registration PUTs sticky-
+            # primary ordering, epoch-aware redirects, and per-endpoint
+            # circuit breakers instead of a single pinned address.
+            try:
+                self._rdv = (resolve_endpoints(addr, port), None)
+            except ValueError:
+                self._rdv = (addr, port)       # resolved lazily per PUT
             self._my_addr = f"{host}:{self._service.port}"
             my_addr = self._my_addr
             self._reg_epoch += 1
@@ -229,6 +238,35 @@ class WorkerNotificationClient:
                               KEY_HOSTS_UPDATED,
                               f"{timestamp} {update_res}".encode(),
                               timeout=5, retries=0)
+
+
+def report_worker_result(exit_code: int = 0):
+    """Self-report this worker's completion to the elastic driver
+    (ISSUE 19): PUT ``worker_results/<host>:<local_rank>`` riding the
+    Endpoints failover set. The launcher's process monitor records exits
+    too — but the monitor dies with the driver process, so across a
+    driver failover this is the ONLY way a surviving worker's completion
+    reaches the promoted driver's finish accounting. Best-effort:
+    failure is a WARNING, never an error in the worker's exit path."""
+    if not os.environ.get(env_mod.HOROVOD_ELASTIC):
+        return
+    addr = os.environ.get(env_mod.HOROVOD_GLOO_RENDEZVOUS_ADDR)
+    if not addr:
+        return
+    port = int(os.environ.get(env_mod.HOROVOD_GLOO_RENDEZVOUS_PORT, "0"))
+    host = os.environ.get(env_mod.HOROVOD_HOSTNAME) or socket.gethostname()
+    local_rank = os.environ.get(env_mod.HOROVOD_LOCAL_RANK, "0")
+    try:
+        put_data_into_kvstore(resolve_endpoints(addr, port or None), None,
+                              SCOPE_WORKER_RESULTS,
+                              f"{host}:{local_rank}",
+                              str(exit_code).encode(), timeout=20)
+    # errflow: ignore[best-effort by design: the self-report is redundant with the launcher's process monitor except across a driver failover; failure is a WARNING and must never turn a clean worker exit into an error]
+    except Exception as e:
+        _LOG.warning(
+            "worker result self-report for %s:%s failed: %s — the driver "
+            "will rely on its local process monitor for this exit",
+            host, local_rank, e)
 
 
 _manager: Optional[WorkerNotificationManager] = None

@@ -133,6 +133,17 @@ FAULT_SPECS: Dict[str, str] = {
     "elastic.reregister": "Inside each attempt of the worker notification "
                           "re-registration after a world reset",
     "elastic.notify": "Inside the driver->worker hosts-updated push",
+    # elastic/failover.py (ISSUE 19)
+    "driver.journal": "Inside every driver-journal append, before the "
+                      "replicated write: drop() models a lost journal "
+                      "entry (WARNING + skipped, driver keeps running); "
+                      "raise() a journal fabric error",
+    "driver.promote": "At the top of standby promotion, before the "
+                      "live-driver deferral check: hang()/raise() model "
+                      "a wedged or failed promotion",
+    "driver.discovery": "Inside each attempt of the hardened host-"
+                        "discovery probe: drop() fails the attempt "
+                        "(retried with backoff, then last-known-good)",
     # checkpoint/manager.py
     "checkpoint.write": "At the top of the background generation write "
                         "(after device_get, before any file/KV I/O): "

@@ -233,13 +233,31 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
     "hvd_tpu_elastic_recoveries_total": (
         "counter", "Elastic run-loop recovery events, by kind (internal, "
                    "raw_runtime, hosts_updated, durable = restored from "
-                   "a durable checkpoint generation)"),
+                   "a durable checkpoint generation, driver_failover = "
+                   "a standby promoted over a dead driver and resumed "
+                   "its in-flight resize)"),
     # elastic/driver.py
     "hvd_tpu_elastic_world_version": (
         "gauge", "Current elastic world version (bumps on every resume)"),
     "hvd_tpu_elastic_events": (
         "events", "Monotonic elastic membership event log: world "
                   "activations, rank join/leave, blacklists"),
+    # elastic/discovery.py
+    "hvd_tpu_discovery_failures_total": (
+        "counter", "Host-discovery probes that failed all retry attempts "
+                   "(the manager served its last-known-good snapshot)"),
+    # elastic/failover.py (ISSUE 19)
+    "hvd_tpu_driver_journal_writes_total": (
+        "counter", "Driver-journal entries committed to the replicated "
+                   "driver scope, by kind (world, started, hosts, "
+                   "pending, strike, blacklist, result)"),
+    "hvd_tpu_driver_promotions_total": (
+        "counter", "Standby-to-driver promotions performed by this "
+                   "process (manual or lease-expiry)"),
+    "hvd_tpu_driver_failovers_total": (
+        "counter", "Automatic driver failovers: promotions triggered by "
+                   "lease expiry over a dead driver (subset of "
+                   "promotions)"),
     # autotune/
     "hvd_tpu_autotune_samples_total": (
         "counter", "Autotune samples registered with the Bayesian optimizer"),

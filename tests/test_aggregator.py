@@ -520,9 +520,18 @@ class TestHealthReport:
             cp = report["control_plane"]
             assert cp["total_requests"] > 0
             assert cp["requests_per_step"] is not None
+            # driver-replication section (ISSUE 19): this fabric runs no
+            # elastic driver and no KV replication, and the report must
+            # say so rather than error out.
+            dr = report["driver_replication"]
+            assert dr["journal_head"] is None
+            assert dr["repl_role"] is None
+            assert dr["promotions"] == 0
             rendered = health.render(report)
             assert "per-slice telemetry freshness" in rendered
             assert "control-plane load" in rendered
+            assert "driver replication:" in rendered
+            assert "no driver journal" in rendered
 
 
 # ---------------------------------------------------------------------------

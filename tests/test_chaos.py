@@ -854,3 +854,284 @@ def test_compressed_step_recovers_through_elastic_restore():
         os.environ.pop("HOROVOD_TPU_COMPRESSION", None)
         os.environ.pop("HOROVOD_TPU_WORLD_VERSION", None)
         hvd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 19 acceptance: SIGKILL the elastic DRIVER process mid-resize. The
+# standby promotes from the replicated journal, the in-flight resize
+# completes at the journaled world version, and every worker reaches its
+# target step with zero process restarts and zero full-fleet restores.
+# ---------------------------------------------------------------------------
+
+_DRIVER_SCRIPT = """
+import sys, time
+from horovod_tpu import faults
+from horovod_tpu.elastic.discovery import HostDiscoveryScript
+from horovod_tpu.elastic.driver import ElasticDriver
+from horovod_tpu.elastic.failover import DriverJournal
+from horovod_tpu.elastic.rendezvous import ElasticRendezvousServer
+from horovod_tpu.runner.replication import ReplicationConfig
+
+p1, p2, hostsfile = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+reps = [f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"]
+server = ElasticRendezvousServer(("127.0.0.1", p1))
+server.start()
+server.enable_replication(
+    reps[0], reps, role="primary",
+    config=ReplicationConfig(lease_timeout=0.5, lease_interval=0.1))
+print("REPL", flush=True)
+sys.stdin.readline()              # test enables its standby, then GO
+disc = HostDiscoveryScript(f"cat {hostsfile}")
+driver = ElasticDriver(server, disc, min_np=2, max_np=4, timeout=30)
+server.set_driver(driver)
+driver.attach_journal(DriverJournal(server))
+# the test spawns the worker processes itself, so they survive this
+# process's SIGKILL like ssh-launched workers survive a driver-host crash
+driver.start(2, lambda s: print(f"START {s.hostname}:{s.local_rank}",
+                                flush=True))
+print("V1", flush=True)
+assert driver.wait_for_world(1, timeout=30)
+# wedge the resize AT THIS DRIVER: every subsequent worker rendezvous GET
+# here is dropped (long-polled), so the in-flight resize can only ever
+# complete at the promoted standby — the kill window is deterministic
+faults.arm("elastic.rendezvous.get=*drop()")
+print("WEDGED", flush=True)
+while True:
+    time.sleep(1)
+"""
+
+_ELASTIC_WORKER_SCRIPT = """
+import sys, threading, time
+from horovod_tpu.elastic.worker import (SCOPE_WORKER_RESULTS,
+                                        WorkerNotificationManager)
+from horovod_tpu.runner.hosts import SlotInfo
+from horovod_tpu.runner.http_client import (put_data_into_kvstore,
+                                            read_data_from_kvstore,
+                                            resolve_endpoints)
+
+spec, host, lr, target = (sys.argv[1], sys.argv[2], int(sys.argv[3]),
+                          int(sys.argv[4]))
+eps = resolve_endpoints(spec)
+interrupt = threading.Event()
+seen = set()
+
+
+class _Listener:
+    def on_hosts_updated(self, ts, res):
+        if ts not in seen:
+            seen.add(ts)
+            interrupt.set()
+
+
+mgr = WorkerNotificationManager()
+version, steps, first = 0, 0, True
+while steps < target:
+    raw = read_data_from_kvstore(eps, None, "rank_and_size",
+                                 f"{host}:{lr}:{version}",
+                                 timeout=120).decode()
+    vs, _, slot_s = raw.partition("|")
+    version = int(vs)
+    slot = SlotInfo.from_response_string(slot_s)
+    if slot.rank < 0:
+        break                                   # scaled out: clean exit
+    if first:
+        # hostname here is only the ADVERTISED notification address (the
+        # slot identity stays `host`); the logical test hostname does not
+        # resolve, so advertise loopback
+        mgr.init(rendezvous_addr=spec, rendezvous_port=0,
+                 rank=slot.rank, hostname="127.0.0.1")
+        mgr.register_listener(_Listener())
+        first = False
+    else:
+        mgr.reregister(rank=slot.rank)
+    interrupt.clear()
+    while steps < target and not interrupt.is_set():
+        time.sleep(0.05)                        # one training step
+        steps += 1
+put_data_into_kvstore(eps, None, SCOPE_WORKER_RESULTS, f"{host}:{lr}",
+                      b"0", timeout=30)
+print(f"DONE {steps}", flush=True)
+"""
+
+
+@pytest.mark.chaos
+def test_driver_sigkill_mid_resize_failover(tmp_path, monkeypatch):
+    """The ISSUE 19 acceptance run: a real SIGKILL of the elastic driver
+    subprocess while a resize is in flight (pending journaled, workers
+    long-polling a wedged driver). The standby must promote from the
+    journal, resume the resize at the journaled world version, launch
+    only the NEW host's worker, and finish with every worker at its
+    target step — no worker process restarted, exactly one
+    driver_failover recovery counted, and the promotion counters visible
+    in the survivor's /metrics scrape."""
+    import signal
+    import subprocess
+    import sys as _sys
+
+    from horovod_tpu.elastic.discovery import HostDiscoveryScript
+    from horovod_tpu.elastic.failover import DriverStandby
+    from horovod_tpu.elastic.rendezvous import ElasticRendezvousServer
+    from horovod_tpu.runner.http_server import find_free_port
+    from horovod_tpu.runner.replication import ReplicationConfig
+
+    monkeypatch.setenv("HOROVOD_TPU_DRIVER_LEASE_TIMEOUT", "0.6")
+    monkeypatch.setenv("HOROVOD_TPU_DRIVER_LEASE_INTERVAL", "0.1")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    target = 120                                 # ~6s of stepping
+    p1, p2 = find_free_port(), find_free_port()
+    spec = f"127.0.0.1:{p1},127.0.0.1:{p2}"
+    hostsfile = tmp_path / "hosts"
+    hostsfile.write_text("hostA:2\n")
+    driver_py = tmp_path / "driver.py"
+    driver_py.write_text(_DRIVER_SCRIPT)
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(_ELASTIC_WORKER_SCRIPT)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo_root + os.pathsep +
+               os.environ.get("PYTHONPATH", ""),
+               HOROVOD_TPU_DRIVER_LEASE_TIMEOUT="0.6",
+               HOROVOD_TPU_DRIVER_LEASE_INTERVAL="0.1")
+    env.pop("HOROVOD_TPU_FAULTS", None)
+
+    def _spawn_worker(host, lr):
+        return subprocess.Popen(
+            [_sys.executable, str(worker_py), spec, host, str(lr),
+             str(target)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            cwd=repo_root, env=env, text=True)
+
+    reg = registry()
+    promotions0 = reg.counter("hvd_tpu_driver_promotions_total").value()
+    failovers0 = reg.counter("hvd_tpu_driver_failovers_total").value()
+    recoveries0 = reg.counter("hvd_tpu_elastic_recoveries_total").value(
+        kind="driver_failover")
+
+    standby_server = ElasticRendezvousServer(("127.0.0.1", p2))
+    standby_server.start()
+    workers = {}
+    standby = None
+    proc = subprocess.Popen(
+        [_sys.executable, str(driver_py), str(p1), str(p2),
+         str(hostsfile)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, cwd=repo_root, env=env, text=True)
+    try:
+        assert "REPL" in proc.stdout.readline()
+        standby_server.enable_replication(
+            f"127.0.0.1:{p2}", [f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"],
+            role="standby",
+            config=ReplicationConfig(lease_timeout=0.5,
+                                     lease_interval=0.1))
+
+        def _on_new_slot(slot):
+            # promoted-driver create_worker_fn: spawn ONLY new slots
+            workers[(slot.hostname, slot.local_rank)] = _spawn_worker(
+                slot.hostname, slot.local_rank)
+
+        standby = DriverStandby(
+            standby_server, HostDiscoveryScript(f"cat {hostsfile}"),
+            min_np=2, max_np=4, timeout=30.0,
+            create_worker_fn=_on_new_slot)
+        standby.start()
+        proc.stdin.write("GO\n")
+        proc.stdin.flush()
+        line = proc.stdout.readline()
+        while "V1" not in line:
+            assert line, "driver subprocess died during activation"
+            line = proc.stdout.readline()
+        for lr in (0, 1):
+            workers[("hostA", lr)] = _spawn_worker("hostA", lr)
+        assert "WEDGED" in proc.stdout.readline()
+
+        # the resize: a new host appears; the wedged driver journals the
+        # pending resume but can never complete it
+        hostsfile.write_text("hostA:2\nhostB:1\n")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            shadow = standby.shadow()
+            if shadow.pending and "hostB" in shadow.hosts:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("pending resize never reached the standby journal")
+
+        # SIGKILL the driver MID-RESIZE
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+
+        # the standby monitor promotes: KV tier first (replica lease),
+        # then the driver tier (journal lease stale)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and standby.driver is None:
+            time.sleep(0.05)
+        promoted = standby.driver
+        assert promoted is not None, "standby never promoted"
+        # the resize resumes AT THE JOURNALED VERSION: v1 was the wedged
+        # world, the promoted driver completes the pending resume as v2
+        assert wait_until_chaos(lambda: promoted.world_version == 2,
+                                timeout=30)
+        assert wait_until_chaos(lambda: not promoted.resume_needed(),
+                                timeout=10)
+        assert promoted.world_size() == 3
+        # only the NEW host's worker was spawned by the promotion path
+        assert set(workers) == {("hostA", 0), ("hostA", 1), ("hostB", 0)}
+        # every worker reaches its target step in its ORIGINAL process
+        assert wait_until_chaos(promoted.finished, timeout=60)
+        assert promoted.error_message is None
+        for key, wp in workers.items():
+            assert wp.wait(timeout=30) == 0, f"worker {key} failed"
+            out = wp.stdout.read()
+            assert f"DONE {target}" in out, \
+                f"worker {key} did not reach target in one life: {out!r}"
+
+        # exactly one driver failover, zero fleet restores
+        assert reg.counter("hvd_tpu_driver_promotions_total").value() \
+            == promotions0 + 1
+        assert reg.counter("hvd_tpu_driver_failovers_total").value() \
+            == failovers0 + 1
+        assert reg.counter("hvd_tpu_elastic_recoveries_total").value(
+            kind="driver_failover") == recoveries0 + 1
+        # the counters are visible in the SURVIVING replica's scrape
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{p2}/metrics/", timeout=10) as resp:
+            scrape = resp.read().decode()
+        assert "hvd_tpu_driver_promotions_total" in scrape
+        assert "hvd_tpu_driver_failovers_total" in scrape
+        assert 'kind="driver_failover"' in scrape
+        # ...and the operator's health report reads the same story off the
+        # survivor: journal head advanced, this replica is now primary,
+        # exactly one promotion/failover on record.
+        import importlib.util as _ilu
+        spec = _ilu.spec_from_file_location(
+            "health_report",
+            os.path.join(repo_root, "tools", "health_report.py"))
+        health = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(health)
+        hr = health.assemble(f"http://127.0.0.1:{p2}")
+        dr = hr["driver_replication"]
+        assert dr["journal_head"] is not None and dr["journal_head"] > 0
+        assert dr["repl_role"] == "primary"
+        assert dr["promotions"] >= 1 and dr["failovers"] >= 1
+        assert dr["failover_recoveries"] >= 1
+        assert "driver replication:" in health.render(hr)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        for wp in workers.values():
+            if wp.poll() is None:
+                wp.kill()
+                wp.wait(timeout=10)
+        if standby is not None:
+            standby.stop()
+        standby_server.stop()
+
+
+def wait_until_chaos(cond, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False

@@ -27,7 +27,14 @@ Sections (``python tools/health_report.py --url http://host:port``):
   convention that nonzero is worth a look and zero is healthy;
 - **control-plane load** — ``hvd_tpu_kv_requests_total`` by verb and
   scope plus requests-per-step (total KV requests over total cluster
-  steps): the number the aggregator tier exists to keep O(slices).
+  steps): the number the aggregator tier exists to keep O(slices);
+- **driver replication** (ISSUE 19) — the elastic driver's journal head
+  (``GET /driver/head``), the KV replica role/epoch and standby apply
+  lag (``GET /_repl/status``), and the promotion/failover counters
+  (``hvd_tpu_driver_{journal_writes,promotions,failovers}_total``,
+  ``hvd_tpu_elastic_recoveries_total{kind="driver_failover"}``) — the
+  at-a-glance answer to "could a standby take over right now, and has
+  one ever had to?".
 
 ``--json`` emits the assembled report as one JSON object instead.
 """
@@ -176,6 +183,38 @@ def control_plane_load(series: Dict[str, list],
     return out
 
 
+def driver_replication(series: Dict[str, list],
+                       repl_status: Optional[dict],
+                       journal_head: Optional[int]) -> dict:
+    """Driver fault-domain health (ISSUE 19): journal head, replica
+    role/epoch, standby apply lag, and the promotion/failover history.
+    ``journal_head is None`` means no elastic driver has journaled yet
+    (non-elastic job, or journaling disabled)."""
+    st = repl_status or {}
+    seq = st.get("seq")
+    applied = st.get("applied_seq")
+    lag = (max(0, int(seq) - int(applied))
+           if isinstance(seq, (int, float)) and
+           isinstance(applied, (int, float)) else None)
+    return {
+        "journal_head": journal_head,
+        "repl_role": st.get("role"),
+        "repl_epoch": st.get("epoch"),
+        "standby_lag": lag,
+        "journal_writes": {
+            "total": _total(series, "hvd_tpu_driver_journal_writes_total"),
+            "by_kind": _by_label(
+                series, "hvd_tpu_driver_journal_writes_total", "kind")},
+        "promotions": _total(series, "hvd_tpu_driver_promotions_total"),
+        "failovers": _total(series, "hvd_tpu_driver_failovers_total"),
+        "failover_recoveries": _total(
+            series, "hvd_tpu_elastic_recoveries_total",
+            kind="driver_failover"),
+        "discovery_failures": _total(
+            series, "hvd_tpu_discovery_failures_total"),
+    }
+
+
 def assemble(url: str, timeout: float = 10.0) -> dict:
     """Fetch all three endpoints and assemble the report dict. Each
     endpoint degrades independently — a root without the /agg route (flat
@@ -193,9 +232,25 @@ def assemble(url: str, timeout: float = 10.0) -> dict:
                 "utf-8", "replace"))
     except Exception as e:
         report["errors"]["metrics"] = str(e)
+    # Optional subsystems: a 404 just means "not replicated" / "no
+    # elastic driver journaling yet", not an unhealthy endpoint.
+    repl_status: Optional[dict] = None
+    try:
+        repl_status = json.loads(
+            _fetch(url.rstrip("/") + "/_repl/status", timeout))
+    except Exception:
+        pass
+    journal_head: Optional[int] = None
+    try:
+        journal_head = int(
+            _fetch(url.rstrip("/") + "/driver/head", timeout))
+    except Exception:
+        pass
     report["slices"] = slice_freshness(agg_summary)
     report["degradation"] = degradation_counters(series)
     report["control_plane"] = control_plane_load(series, agg_summary)
+    report["driver_replication"] = driver_replication(
+        series, repl_status, journal_head)
     try:
         from horovod_tpu.trace import load_trace_events
         from tools.trace_report import arrival_skew, straggler_ranking
@@ -280,6 +335,32 @@ def render(report: dict) -> str:
     if verbs:
         row = "  ".join(f"{v}={n:.0f}" for v, n in sorted(verbs.items()))
         lines.append(f"  by verb: {row}")
+    dr = report.get("driver_replication", {})
+    lines.append("")
+    lines.append("driver replication:")
+    head = dr.get("journal_head")
+    if head is None:
+        lines.append("  journal: no driver journal at this server "
+                     "(non-elastic job, or HOROVOD_TPU_DRIVER_JOURNAL=0)")
+    else:
+        jw = dr.get("journal_writes", {})
+        by_kind = " ".join(f"{k}={v:.0f}" for k, v
+                           in sorted(jw.get("by_kind", {}).items()))
+        lines.append(f"  journal head: seq {head}"
+                     + (f"  ({by_kind})" if by_kind else ""))
+    role = dr.get("repl_role")
+    if role is None:
+        lines.append("  kv replication: not enabled at this server")
+    else:
+        lag = dr.get("standby_lag")
+        lines.append(
+            f"  kv replica: role={role} epoch={dr.get('repl_epoch')}  "
+            f"standby lag={'?' if lag is None else f'{lag} entries'}")
+    lines.append(
+        f"  promotions: {dr.get('promotions', 0):.0f}  "
+        f"failovers: {dr.get('failovers', 0):.0f}  "
+        f"failover recoveries: {dr.get('failover_recoveries', 0):.0f}  "
+        f"discovery failures: {dr.get('discovery_failures', 0):.0f}")
     return "\n".join(lines)
 
 
