@@ -1651,6 +1651,24 @@ def main():
         "fallbacks": eng.replay.fallbacks,
     }
 
+    # ---- step-health digest stream (ISSUE 20) -----------------------------
+    # The replay loop above drove real step_begin/step_end brackets, so the
+    # step-health monitor accumulated one digest per step; tail latency
+    # comes from those digests, not from re-timing. anomaly_count over a
+    # clean synthetic run is the detector's false-positive face.
+    step_health_metrics = {}
+    if eng.health is not None:
+        walls = sorted(d.wall_s for d in eng.health.recent()
+                       if d.wall_s is not None)
+        if walls:
+            def _pct(q):
+                return walls[min(len(walls) - 1, int(q * len(walls)))]
+            step_health_metrics = {
+                "step_time_p50_ms": round(_pct(0.50) * 1e3, 3),
+                "step_time_p99_ms": round(_pct(0.99) * 1e3, 3),
+                "anomaly_count": eng.health.anomaly_count,
+            }
+
     # ---- comm/compute overlap attribution (ISSUE 6) -----------------------
     # The same replayed eager step driven twice — overlap_pipeline "off"
     # (the PR 1 serial chain) vs the configured/auto pipelined mode — with
@@ -1888,6 +1906,7 @@ def main():
         "eager_replay_spread_pct": round(replay_spread, 1),
         "eager_replay_vs_spmd": round(replay_img_s / spmd_img_s, 3),
         "replay_counters": replay_counters,
+        **step_health_metrics,
         "eager_gap_attribution": gap_attribution,
         **overlap_metrics,
         **registry_telemetry,

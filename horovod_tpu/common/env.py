@@ -133,6 +133,18 @@ HOROVOD_TPU_TRACE = "HOROVOD_TPU_TRACE"
 HOROVOD_TPU_TRACE_RING = "HOROVOD_TPU_TRACE_RING"
 HOROVOD_TPU_TRACE_INTERVAL = "HOROVOD_TPU_TRACE_INTERVAL"
 HOROVOD_TPU_TRACE_DUMP_DIR = "HOROVOD_TPU_TRACE_DUMP_DIR"
+# step-health layer (horovod_tpu/observability/, ISSUE 20): =0 leaves
+# engine.health None — one is-None branch on the step path, nothing
+# else. WINDOW/WARMUP shape the rolling median+MAD baselines, MAD_K is
+# the spike threshold in MADs, DUMP_INTERVAL rate-limits automatic
+# flight dumps (seconds), HBM toggles emitter-thread memory sampling.
+HOROVOD_TPU_STEP_HEALTH = "HOROVOD_TPU_STEP_HEALTH"
+HOROVOD_TPU_STEP_HEALTH_WINDOW = "HOROVOD_TPU_STEP_HEALTH_WINDOW"
+HOROVOD_TPU_STEP_HEALTH_WARMUP = "HOROVOD_TPU_STEP_HEALTH_WARMUP"
+HOROVOD_TPU_STEP_HEALTH_MAD_K = "HOROVOD_TPU_STEP_HEALTH_MAD_K"
+HOROVOD_TPU_STEP_HEALTH_DUMP_INTERVAL = (
+    "HOROVOD_TPU_STEP_HEALTH_DUMP_INTERVAL")
+HOROVOD_TPU_HBM = "HOROVOD_TPU_HBM"
 # collective watchdog (stall_inspector.py): seconds a collective may sit
 # outstanding — or a peer heartbeat may lag — before the inspector aborts
 # local collectives and raises HorovodInternalError so the elastic
@@ -474,6 +486,12 @@ class Config:
     trace_ring: int = 4096
     trace_interval: float = 5.0
     trace_dump_dir: Optional[str] = None
+    step_health: bool = True
+    step_health_window: int = 64
+    step_health_warmup: int = 8
+    step_health_mad_k: float = 3.0
+    step_health_dump_interval: float = 60.0
+    hbm_telemetry: bool = True
     agg_enable: bool = True
     agg_interval: float = 5.0
     agg_cardinality: str = "rank"
@@ -595,6 +613,13 @@ class Config:
             trace_ring=_get_int(HOROVOD_TPU_TRACE_RING, 4096),
             trace_interval=_get_float(HOROVOD_TPU_TRACE_INTERVAL, 5.0),
             trace_dump_dir=os.environ.get(HOROVOD_TPU_TRACE_DUMP_DIR) or None,
+            step_health=_get_bool(HOROVOD_TPU_STEP_HEALTH, True),
+            step_health_window=_get_int(HOROVOD_TPU_STEP_HEALTH_WINDOW, 64),
+            step_health_warmup=_get_int(HOROVOD_TPU_STEP_HEALTH_WARMUP, 8),
+            step_health_mad_k=_get_float(HOROVOD_TPU_STEP_HEALTH_MAD_K, 3.0),
+            step_health_dump_interval=_get_float(
+                HOROVOD_TPU_STEP_HEALTH_DUMP_INTERVAL, 60.0),
+            hbm_telemetry=_get_bool(HOROVOD_TPU_HBM, True),
             agg_enable=_get_bool(HOROVOD_TPU_AGG_ENABLE, True),
             agg_interval=_get_float(HOROVOD_TPU_AGG_INTERVAL, 5.0),
             agg_cardinality=_get_choice(

@@ -391,6 +391,35 @@ KNOB_SPECS: Dict[str, dict] = {
         "type": "str", "default": "",
         "help": "Directory for the watchdog's flight-recorder trace dump "
                 "(hvd_tpu_flight_rank<r>.json)."},
+    # -- step health (ISSUE 20) ---------------------------------------------
+    "HOROVOD_TPU_STEP_HEALTH": {
+        "type": "bool", "default": "1",
+        "help": "Per-step health digests + online anomaly detection; =0 "
+                "leaves engine.health None (one is-None branch on the "
+                "step path, nothing else)."},
+    "HOROVOD_TPU_STEP_HEALTH_WINDOW": {
+        "type": "int", "default": "64",
+        "help": "Rolling-baseline window (steps) for the median+MAD "
+                "anomaly detector."},
+    "HOROVOD_TPU_STEP_HEALTH_WARMUP": {
+        "type": "int", "default": "8",
+        "help": "Steps of history required before the detector "
+                "classifies anything (the warmup gate)."},
+    "HOROVOD_TPU_STEP_HEALTH_MAD_K": {
+        "type": "float", "default": "3.0",
+        "help": "Spike threshold in MADs above the rolling median; "
+                "sustained regressions use half of it."},
+    "HOROVOD_TPU_STEP_HEALTH_DUMP_INTERVAL": {
+        "type": "float", "default": "60.0",
+        "help": "Minimum seconds between automatic flight-recorder "
+                "dumps (anomaly- and elastic-restore-triggered; the "
+                "watchdog's one-shot escalation dump is not rate-"
+                "limited)."},
+    "HOROVOD_TPU_HBM": {
+        "type": "bool", "default": "1",
+        "help": "Sample device.memory_stats() on the metrics-emitter "
+                "thread (hvd_tpu_hbm_bytes gauges + digest watermark); "
+                "platforms without memory stats auto-disable."},
     # -- hierarchical telemetry ---------------------------------------------
     "HOROVOD_TPU_AGG_ENABLE": {
         "type": "bool", "default": "1",

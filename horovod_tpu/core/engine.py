@@ -343,6 +343,11 @@ class Engine:
         # (tracing off) each hook site below is a single is-None check —
         # the HOROVOD_TPU_METRICS=0 no-new-locking guarantee.
         self.trace = None
+        # step-health monitor (horovod_tpu/observability/, ISSUE 20):
+        # wired by GlobalState unless HOROVOD_TPU_STEP_HEALTH=0. Same
+        # discipline as trace: when None, step_end pays exactly one
+        # is-None branch and nothing else.
+        self.health = None
         # per-activity sub-span hook (timeline ACTIVITY events, the nested
         # spans of timeline.h:77 NEGOTIATING->TOP_LEVEL->ACTIVITY)
         self.on_activity: Optional[Callable[[str, str, float], None]] = None
@@ -1024,6 +1029,8 @@ class Engine:
         if self.trace is not None:
             self.trace.record_step(begin=False)
         self.step_index += 1
+        if self.health is not None:
+            self.health.on_step_end()
         if self.on_step_complete is not None:
             try:
                 self.on_step_complete(self.step_index)

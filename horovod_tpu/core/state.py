@@ -27,6 +27,9 @@ class GlobalState:
         self.checkpoint_manager = None
         self.slice_aggregator = None
         self.telemetry_route = None
+        self.step_health = None
+        self.flight_dumper = None
+        self.hbm_sampler = None
 
     def init(self):
         with self._lock:
@@ -130,33 +133,30 @@ class GlobalState:
                     self.trace_recorder, kv, rank=self.backend.rank(),
                     interval=cfg.trace_interval, route=route)
                 self.trace_publisher.start()
+        # flight recorder (horovod_tpu/trace.py): dumps the last-N
+        # in-memory trace spans to disk. Three consumers share the hook
+        # (ISSUE 20): the watchdog's one-shot escalation calls the raw
+        # method (a hang post-mortem is never rate-limited away), while
+        # the step-health anomaly detector and the elastic-restore path
+        # go through the rate-limited FlightDumper so an anomaly storm
+        # or a tight restore loop cannot turn the ring into a firehose.
+        from ..observability import FlightDumper
+        self.flight_dumper = FlightDumper(
+            self._dump_flight_ring,
+            min_interval=cfg.step_health_dump_interval)
         if not cfg.stall_check_disable or cfg.collective_deadline > 0:
             from ..stall_inspector import StallInspector
             # collective-watchdog escalation (HOROVOD_TPU_COLLECTIVE_
             # DEADLINE): poison the engine so every later submission/
             # synchronize raises instead of queueing behind the wedged
             # collective; the inspector itself breaks fault-injection
-            # hangs with the same HorovodInternalError.
+            # hangs with the same HorovodInternalError. The escalation
+            # dump runs BEFORE the engine is poisoned, so the post-mortem
+            # always has the spans that led into the hang.
             eng = self.engine
 
             def _escalate(err):
                 eng.poison(err)
-
-            # flight recorder (horovod_tpu/trace.py): the one-shot
-            # escalation dumps the last-N in-memory trace spans to disk
-            # BEFORE the engine is poisoned, so a hang post-mortem always
-            # has the spans that led into it.
-            recorder = self.trace_recorder
-            rank = self.backend.rank()
-            dump_dir = cfg.trace_dump_dir
-
-            def _flight_dump():
-                if recorder is None:
-                    return None
-                path = os.path.join(
-                    dump_dir or os.getcwd(),
-                    f"hvd_tpu_flight_rank{rank}.json")
-                return recorder.dump(path)
 
             # HOROVOD_STALL_CHECK_DISABLE silences the warning AND
             # shutdown tiers, but a configured collective deadline still
@@ -170,7 +170,7 @@ class GlobalState:
                                   else cfg.stall_shutdown_seconds),
                 kv=kv, rank=self.backend.rank(), size=self.backend.size(),
                 collective_deadline=cfg.collective_deadline,
-                escalate=_escalate, flight_dump=_flight_dump,
+                escalate=_escalate, flight_dump=self._dump_flight_ring,
                 route=route, topology=topo,
                 agg_interval=cfg.agg_interval)
         # async sharded checkpointing (ISSUE 9, horovod_tpu/checkpoint/):
@@ -197,14 +197,35 @@ class GlobalState:
         # GET /metrics on the runner server), Chrome-trace counter tracks
         from ..metrics import MetricsEmitter, registry as metrics_registry
         reg = metrics_registry()
+        # HBM telemetry (ISSUE 20): device.memory_stats() sampled on the
+        # emitter thread, never the step path; platforms without memory
+        # stats detect that on the first sample and quietly stop.
+        if cfg.hbm_telemetry and reg.enabled:
+            from ..observability import HBMSampler
+            self.hbm_sampler = HBMSampler()
         if reg.enabled and (cfg.metrics_file or kv is not None
                             or self.timeline is not None):
             self.metrics_emitter = MetricsEmitter(
                 reg, interval=cfg.metrics_interval,
                 jsonl_path=cfg.metrics_file, kv=kv,
                 rank=self.backend.rank(), timeline=self.timeline,
-                route=route)
+                route=route, hbm_sampler=self.hbm_sampler)
             self.metrics_emitter.start()
+        # step-health monitor (ISSUE 20, horovod_tpu/observability/):
+        # per-step digests from registry deltas + online median/MAD
+        # anomaly detection, with anomaly-triggered rate-limited flight
+        # dumps. Digest-derived instruments ride the emitter's normal
+        # publish path (and therefore the per-slice aggregator tier) —
+        # no new rank->root publishes. =0 leaves engine.health None.
+        if cfg.step_health:
+            from ..observability import StepHealthMonitor
+            self.step_health = StepHealthMonitor(
+                self.engine, rank=self.backend.rank(),
+                window=cfg.step_health_window,
+                warmup=cfg.step_health_warmup,
+                mad_k=cfg.step_health_mad_k,
+                dumper=self.flight_dumper, hbm=self.hbm_sampler)
+            self.engine.health = self.step_health
 
         if cfg.autotune:
             from ..autotune.parameter_manager import ParameterManager
@@ -395,6 +416,23 @@ class GlobalState:
             # the watchdog's peer leg must not read that as a hang
             engine.on_join_state = stall.set_heartbeat_idle
 
+    def _dump_flight_ring(self) -> Optional[str]:
+        """Dump the in-memory trace ring to the flight-recorder file and
+        return its path (None when tracing is off). A method — not a
+        closure in :meth:`_wire_observability` — so each caller (watchdog
+        escalation, FlightDumper) always sees the live recorder, and the
+        wiring body stays free of tail return statements."""
+        import os
+        recorder = self.trace_recorder
+        if recorder is None:
+            return None
+        cfg = self.config
+        dump_dir = (cfg.trace_dump_dir if cfg is not None else "")
+        rank = self.backend.rank() if self.backend is not None else 0
+        path = os.path.join(dump_dir or os.getcwd(),
+                            f"hvd_tpu_flight_rank{rank}.json")
+        return recorder.dump(path)
+
     def shutdown(self):
         with self._lock:
             if self.engine is not None:
@@ -435,6 +473,11 @@ class GlobalState:
                 self.slice_aggregator.stop(final_rollup=True)
                 self.slice_aggregator = None
             self.telemetry_route = None
+            # monitor/dumper/sampler are threadless — the engine and
+            # emitter that drove them are already stopped above
+            self.step_health = None
+            self.flight_dumper = None
+            self.hbm_sampler = None
             if self.parameter_manager is not None:
                 self.parameter_manager.close()
                 self.parameter_manager = None

@@ -40,6 +40,18 @@ _MAX_RUNTIME_ERROR_RETRIES = int(os.environ.get(
     "HOROVOD_ELASTIC_MAX_RUNTIME_RETRIES", "3"))
 
 
+def _dump_on_restore():
+    """Write a rate-limited flight-recorder dump on the restore path, so
+    the trace of the failed collective survives the engine rebuild."""
+    try:
+        from ..core.state import global_state
+        dumper = global_state().flight_dumper
+        if dumper is not None:
+            dumper(trigger="elastic_restore")
+    except Exception:  # errflow: ignore[a telemetry dump must never delay or fail elastic recovery]
+        _LOG.debug("restore-path flight dump failed", exc_info=True)
+
+
 def _recoverable_errors():
     """Exception classes the run-loop treats as a collective failure.
 
@@ -158,6 +170,11 @@ def run_fn(func, reset):
                         _m_recoveries.inc(kind="raw_runtime")
                     _LOG.info("collective failure; restoring last committed "
                               "state and re-initializing")
+                    # flight dump (ISSUE 20): capture the trace ring
+                    # BEFORE reset() tears the engine down — the spans
+                    # explaining why the world died are still in it.
+                    # Rate-limited (shared FlightDumper), best-effort.
+                    _dump_on_restore()
                     state.restore()
                     skip_sync = False
                 except HostsUpdatedInterrupt as e:

@@ -103,6 +103,12 @@ FAULT_SPECS: Dict[str, str] = {
                         "raise() models a prefetch launch failure — it "
                         "must surface as HorovodInternalError for the "
                         "elastic loop, never poison held state",
+    # observability/monitor.py (ISSUE 20 step health)
+    "observability.dump": "Inside the rate-limited flight dumper, before "
+                          "the trace-ring dump is written; raise() models "
+                          "a dump failure (full disk) — it must be "
+                          "swallowed, never fail the step or the elastic "
+                          "restore that triggered it",
     # runner/http_client.py
     "kv.put": "Inside each PUT attempt of put_data_into_kvstore (before "
               "the HTTP request) — transient KV-fabric write outages",

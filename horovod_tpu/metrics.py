@@ -133,6 +133,29 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
     "hvd_tpu_straggler_rank": (
         "gauge", "Rank most often last to arrive over the correlated "
                  "collectives in the merged trace window"),
+    # observability/ (ISSUE 20 step-health layer)
+    "hvd_tpu_step_seconds": (
+        "histogram", "Per-step wall time observed by the step-health "
+                     "monitor (step_end-to-step_end cadence) — the "
+                     "cluster p50/p99 SLO signal health_report reads"),
+    "hvd_tpu_step_anomalies_total": (
+        "counter", "Step-health anomalies classified by the rolling "
+                   "median+MAD detector, by class (step_time_spike, "
+                   "sustained_regression, straggler_drift, "
+                   "straggler_wait, dispatch_change, wire_shift)"),
+    "hvd_tpu_step_health_events": (
+        "events", "Step-health anomaly event log: one entry per "
+                  "classified anomaly with its human-readable evidence "
+                  "line"),
+    "hvd_tpu_hbm_bytes": (
+        "gauge", "Device memory sampled off the hot path on the emitter "
+                 "thread, by kind (in_use/peak/limit) — the headroom "
+                 "signal for admission control and memory-vs-MFU "
+                 "tradeoffs"),
+    "hvd_tpu_flight_dumps_total": (
+        "counter", "Flight-recorder dumps written through the "
+                   "rate-limited dumper, by trigger (anomaly class, "
+                   "elastic_restore, manual)"),
     # checkpoint/ (ISSUE 9 async sharded checkpointing)
     "hvd_tpu_ckpt_snapshots_total": (
         "counter", "Checkpoint snapshot requests, by outcome (written, "
@@ -750,7 +773,7 @@ class MetricsEmitter(threading.Thread):
     def __init__(self, reg: Registry, interval: float = 10.0,
                  jsonl_path: Optional[str] = None,
                  kv: Optional[Tuple[str, int]] = None, rank: int = 0,
-                 timeline=None, route=None):
+                 timeline=None, route=None, hbm_sampler=None):
         super().__init__(name="hvd-metrics", daemon=True)
         self.reg = reg
         self.interval = max(float(interval), 0.05)
@@ -759,6 +782,10 @@ class MetricsEmitter(threading.Thread):
         self.rank = rank
         self.timeline = timeline
         self.route = route
+        # ISSUE 20: HBM gauges are sampled HERE, on the emitter thread,
+        # before the snapshot — device.memory_stats() never runs on the
+        # step path
+        self.hbm_sampler = hbm_sampler
         # NOT named _stop: Thread.join() calls an internal _stop()
         self._stop_evt = threading.Event()
         self._prev: Optional[Tuple[float, float, float]] = None
@@ -780,6 +807,11 @@ class MetricsEmitter(threading.Thread):
     def tick(self):
         import logging
         log = logging.getLogger("horovod_tpu.metrics")
+        if self.hbm_sampler is not None:
+            try:
+                self.hbm_sampler.sample()
+            except Exception as e:
+                log.debug("HBM sample failed: %s", e)
         snap = self.reg.snapshot()
         now = time.time()
         if self.jsonl_path:

@@ -1,0 +1,22 @@
+"""Continuous step-health layer (ISSUE 20).
+
+Online per-step digests assembled at ``step_end`` from registry deltas
+and the trace ring, a rolling median+MAD anomaly detector that
+classifies spikes/regressions/straggler drift while training runs, a
+rate-limited automatic flight dumper riding the PR 5 hook, and an HBM
+sampler on the emitter thread. Wired by
+:meth:`horovod_tpu.core.state.GlobalState.init` when
+``HOROVOD_TPU_STEP_HEALTH=1`` (the default); ``=0`` leaves
+``engine.health`` None — one is-None branch on the step path, nothing
+else.
+"""
+
+from .detector import (ANOMALY_CLASSES, Anomaly, AnomalyDetector,
+                       RollingBaseline)
+from .digest import StepDigest
+from .monitor import FlightDumper, HBMSampler, StepHealthMonitor
+
+__all__ = [
+    "ANOMALY_CLASSES", "Anomaly", "AnomalyDetector", "RollingBaseline",
+    "StepDigest", "FlightDumper", "HBMSampler", "StepHealthMonitor",
+]

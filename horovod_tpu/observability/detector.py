@@ -1,0 +1,236 @@
+"""Online anomaly detection over the per-step digest stream (ISSUE 20).
+
+The detector keeps a rolling *robust* baseline per digest field — a
+bounded window over which it computes the median and the MAD (median
+absolute deviation) — and classifies each new digest against it. Robust
+statistics matter here: one straggler step barely moves a 64-sample
+median, where it would drag a mean/stddev pair far enough to hide the
+second spike in a row.
+
+Everything in this module is plain arithmetic over floats: no locks, no
+registry handles, no engine references. The :class:`StepHealthMonitor`
+owns the instruments and calls :meth:`AnomalyDetector.observe` once per
+step, off the dispatch hot path.
+
+Emission is edge-triggered: each class fires when the field *enters* an
+anomalous regime, not on every step it stays there — a replay fallback
+that permanently doubles the dispatch count is one ``dispatch_change``
+event, after which the rolling window adapts to the new regime.
+
+Classes of anomaly (the ``class`` label on
+``hvd_tpu_step_anomalies_total``):
+
+``step_time_spike``
+    Step wall time deviates > ``mad_k`` MADs above the median.
+``sustained_regression``
+    ``sustain`` consecutive steps sit > ``mad_k/2`` MADs above the
+    median — a new slower regime, not a blip.
+``straggler_drift``
+    This rank's step time spiked while its OWN collective wait stayed
+    flat: the slowdown is local, i.e. *this rank is the straggler* the
+    rest of the cluster is waiting on. Purely local detection — the
+    delayed rank arrives last, so its enqueue-to-complete latency stays
+    small while everyone else's grows.
+``straggler_wait``
+    The converse: step time and collective wait spiked together — this
+    rank is healthy but waiting on a remote straggler.
+``dispatch_change``
+    The per-step dispatch count moved off its baseline (the classic
+    cause: step-capture replay fell back to eager dispatch).
+``wire_shift``
+    Per-step wire bytes moved off baseline (algorithm selection or
+    codec choice flipped, or the model's collective set changed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+from .digest import StepDigest
+
+ANOMALY_CLASSES = (
+    "step_time_spike", "sustained_regression", "straggler_drift",
+    "straggler_wait", "dispatch_change", "wire_shift",
+)
+
+
+@dataclasses.dataclass
+class Anomaly:
+    """One classified deviation; ``detail`` is the human-readable line
+    that lands in the ``hvd_tpu_step_health_events`` EventLog."""
+    cls: str
+    detail: str
+    step: int
+    value: float
+    median: float
+    mad: float
+
+
+class RollingBaseline:
+    """Streaming median + MAD over a bounded window, warmup-gated.
+
+    ``update`` is O(window log window) (one sorted copy of a <=
+    ``window``-element list) and runs once per step per field — cheap in
+    absolute terms and entirely off the dispatch hot path. ``floor`` is
+    the minimum spread used when deviations are scored, so a perfectly
+    constant baseline (MAD 0) does not hair-trigger on float noise.
+    """
+
+    def __init__(self, window: int = 64, warmup: int = 8,
+                 floor: float = 1e-6):
+        if window < 2:
+            raise ValueError("baseline window must be >= 2")
+        self.window = window
+        self.warmup = max(2, warmup)
+        self.floor = floor
+        self._values: List[float] = []
+        self._median = 0.0
+        self._mad = 0.0
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    @property
+    def ready(self) -> bool:
+        """Warmup gate: no classification until enough history exists."""
+        return len(self._values) >= self.warmup
+
+    @property
+    def median(self) -> float:
+        return self._median
+
+    @property
+    def mad(self) -> float:
+        return self._mad
+
+    def deviation(self, x: float) -> float:
+        """Signed distance from the median in MAD units (0.0 until the
+        warmup gate opens)."""
+        if not self.ready:
+            return 0.0
+        spread = max(self._mad, self.floor)
+        return (x - self._median) / spread
+
+    def update(self, x: float) -> None:
+        self._values.append(float(x))
+        if len(self._values) > self.window:
+            del self._values[0]
+        s = sorted(self._values)
+        n = len(s)
+        mid = n // 2
+        self._median = s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+        dev = sorted(abs(v - self._median) for v in s)
+        self._mad = dev[mid] if n % 2 else 0.5 * (dev[mid - 1] + dev[mid])
+
+
+class AnomalyDetector:
+    """Classifies each :class:`StepDigest` against rolling baselines.
+
+    Deviations are scored against the baseline *before* the new sample
+    is folded in, so a spike is measured against history that does not
+    yet include it; the sample is then folded regardless (a lone spike
+    cannot move a windowed median, and folding lets the baseline adapt
+    to genuine regime changes instead of alerting forever).
+    """
+
+    def __init__(self, window: int = 64, warmup: int = 8,
+                 mad_k: float = 3.0, sustain: int = 5):
+        self.mad_k = mad_k
+        self.sustain = max(2, sustain)
+        self._step_time = RollingBaseline(window, warmup, floor=1e-4)
+        self._wait = RollingBaseline(window, warmup, floor=1e-4)
+        self._dispatches = RollingBaseline(window, warmup, floor=0.25)
+        self._wire = RollingBaseline(window, warmup, floor=1.0)
+        self._spiking = False      # inside a step-time spike episode
+        self._above = 0            # consecutive mildly-slow steps
+        self._regressed = False    # sustained_regression emitted
+        self._scalar_flags = {"dispatch_change": False, "wire_shift": False}
+
+    def baselines(self) -> Dict[str, RollingBaseline]:
+        return {"step_time": self._step_time, "wait": self._wait,
+                "dispatches": self._dispatches, "wire_bytes": self._wire}
+
+    def observe(self, d: StepDigest, rank: int = 0) -> List[Anomaly]:
+        out: List[Anomaly] = []
+        if d.wall_s is not None:
+            self._observe_step_time(d, rank, out)
+        self._observe_scalar(
+            d, self._dispatches, float(d.dispatches), "dispatch_change",
+            self._dispatch_detail(d), out)
+        self._observe_scalar(
+            d, self._wire, float(d.wire_bytes), "wire_shift",
+            f"per-step wire bytes moved to {d.wire_bytes:.0f} "
+            f"(links: {sorted(d.wire_by_link)})", out)
+        return out
+
+    # -- per-class rules ---------------------------------------------------
+
+    def _observe_step_time(self, d: StepDigest, rank: int,
+                           out: List[Anomaly]) -> None:
+        wall = float(d.wall_s)
+        wait = float(d.collective_wait_s)
+        dev = self._step_time.deviation(wall)
+        wait_dev = self._wait.deviation(wait)
+        spike = self._step_time.ready and dev > self.mad_k
+        if spike and not self._spiking:
+            out.append(Anomaly(
+                "step_time_spike",
+                f"step {d.step} took {wall * 1e3:.1f} ms "
+                f"(+{dev:.1f} MADs over median "
+                f"{self._step_time.median * 1e3:.1f} ms)",
+                d.step, wall, self._step_time.median, self._step_time.mad))
+            if self._wait.ready and wait_dev > self.mad_k:
+                out.append(Anomaly(
+                    "straggler_wait",
+                    f"rank {rank} waiting on a remote straggler: "
+                    f"collective wait {wait * 1e3:.1f} ms "
+                    f"(+{wait_dev:.1f} MADs) explains the step spike",
+                    d.step, wait, self._wait.median, self._wait.mad))
+            elif self._wait.ready and wait_dev <= self.mad_k / 2:
+                out.append(Anomaly(
+                    "straggler_drift",
+                    f"rank {rank} is the straggler: step "
+                    f"+{dev:.1f} MADs with flat collective wait "
+                    f"({wait * 1e3:.1f} ms, {wait_dev:+.1f} MADs) — "
+                    f"the slowdown is local to rank {rank}",
+                    d.step, wall, self._step_time.median,
+                    self._step_time.mad))
+        self._spiking = spike
+        # sustained regression: a run of mildly-slow steps, emitted once
+        # per episode
+        if self._step_time.ready and dev > self.mad_k / 2:
+            self._above += 1
+            if self._above >= self.sustain and not self._regressed:
+                self._regressed = True
+                out.append(Anomaly(
+                    "sustained_regression",
+                    f"{self._above} consecutive steps above baseline "
+                    f"(median {self._step_time.median * 1e3:.1f} ms, "
+                    f"now {wall * 1e3:.1f} ms)",
+                    d.step, wall, self._step_time.median,
+                    self._step_time.mad))
+        else:
+            self._above = 0
+            self._regressed = False
+        self._step_time.update(wall)
+        self._wait.update(wait)
+
+    def _observe_scalar(self, d: StepDigest, base: RollingBaseline,
+                        value: float, cls: str, detail: str,
+                        out: List[Anomaly]) -> None:
+        dev = base.deviation(value)
+        anomalous = base.ready and abs(dev) > self.mad_k
+        if anomalous and not self._scalar_flags[cls]:
+            out.append(Anomaly(
+                cls, f"step {d.step}: {detail} "
+                f"(baseline median {base.median:.0f}, {dev:+.1f} MADs)",
+                d.step, value, base.median, base.mad))
+        self._scalar_flags[cls] = anomalous
+        base.update(value)
+
+    @staticmethod
+    def _dispatch_detail(d: StepDigest) -> str:
+        why = ("replay fell back to eager dispatch"
+               if d.replay_fallbacks else "dispatch count changed")
+        return f"{d.dispatches} dispatches this step — {why}"

@@ -330,6 +330,50 @@ def test_sharded_prefetch_survives_elastic_restore():
         hvd.shutdown()
 
 
+def test_elastic_restore_writes_flight_dump(monkeypatch, tmp_path):
+    """ISSUE 20 satellite: when HorovodInternalError hands control to
+    the elastic restore path, a flight dump is written BEFORE the
+    restore (through the rate-limited FlightDumper, trigger
+    ``elastic_restore``) — even with the stall watchdog disabled, so
+    the post-mortem tier does not depend on the escalation tier."""
+    monkeypatch.setenv("HOROVOD_TPU_TRACE_DUMP_DIR", str(tmp_path))
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_DISABLE", "1")
+    hvd.shutdown()
+    hvd.init()
+    reg = registry()
+    dumps_before = reg.counter("hvd_tpu_flight_dumps_total").value(
+        trigger="elastic_restore")
+    try:
+        faults.arm("engine.enqueue=1*raise(HorovodInternalError)")
+        state = _CountingState(batch=0)
+        target = 4
+
+        @hvd.elastic.run
+        def train(state):
+            while state.batch < target:
+                out = np.asarray(hvd.allreduce(
+                    np.ones(2, np.float32),
+                    name=f"erd.b{state.batch}.r{state.restores}",
+                    op=hvd.Sum))
+                assert out[0] == hvd.size()
+                state.batch += 1
+                state.commit()
+            return state.batch
+
+        assert train(state) == target
+        assert state.restores == 1, "run-loop never restored"
+        assert faults.hits("engine.enqueue") == 1
+        dump = tmp_path / f"hvd_tpu_flight_rank{hvd.rank()}.json"
+        assert dump.exists(), "elastic restore wrote no flight dump"
+        with open(dump) as f:
+            assert json.load(f)["otherData"]["flight_recorder"] is True
+        assert reg.counter("hvd_tpu_flight_dumps_total").value(
+            trigger="elastic_restore") == dumps_before + 1
+    finally:
+        faults.disarm()
+        hvd.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # Acceptance: transient KV outage loses no stall/metrics/registration
 # writes — final KV state matches the no-fault run (two-rank write set).

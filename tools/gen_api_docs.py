@@ -38,6 +38,10 @@ SECTIONS = [
         "registry", "Registry", "Counter", "Gauge", "Histogram", "EventLog",
         "MetricsEmitter", "render_prometheus", "render_prometheus_cluster",
         "publish_snapshot"]),
+    ("Step health & anomaly detection", "horovod_tpu.observability", [
+        "StepDigest", "RollingBaseline", "AnomalyDetector", "Anomaly",
+        "StepHealthMonitor", "FlightDumper", "HBMSampler",
+        "ANOMALY_CLASSES"]),
     ("State synchronization", "horovod_tpu", [
         "broadcast_parameters", "broadcast_optimizer_state",
         "broadcast_object", "allgather_object", "allreduce_sparse"]),

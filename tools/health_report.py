@@ -34,9 +34,18 @@ Sections (``python tools/health_report.py --url http://host:port``):
   (``hvd_tpu_driver_{journal_writes,promotions,failovers}_total``,
   ``hvd_tpu_elastic_recoveries_total{kind="driver_failover"}``) — the
   at-a-glance answer to "could a standby take over right now, and has
-  one ever had to?".
+  one ever had to?";
+- **step health / SLO** (ISSUE 20) — cluster p50/p99 step time from the
+  merged ``hvd_tpu_step_seconds`` histogram, the anomaly inventory by
+  class and rank (``hvd_tpu_step_anomalies_total``), flight dumps by
+  trigger, and per-rank HBM headroom (``hvd_tpu_hbm_bytes``).
 
-``--json`` emits the assembled report as one JSON object instead.
+``--json`` emits the assembled report as one JSON object.
+``--format=json`` instead emits the *evaluated* report in the
+``tools/check.py`` shape — ``{"ok": bool, "checks": {section:
+{"ok", "errors", "stats"}}}`` — and the process exits nonzero when any
+section is red, so CI and chaos jobs can assert on cluster health
+machine-readably.
 """
 
 from __future__ import annotations
@@ -98,6 +107,29 @@ def _by_label(series: Dict[str, list], name: str, label: str
         key = labels.get(label, "")
         out[key] = out.get(key, 0.0) + v
     return out
+
+
+def histogram_quantile(series: Dict[str, list], name: str,
+                       q: float) -> Optional[float]:
+    """Quantile estimate from merged Prometheus histogram ``_bucket``
+    series (the bucket upper bound the q-th observation falls in —
+    log2 buckets, so the estimate is within 2x). Cumulative counts are
+    summed across every rank's series per ``le`` bound."""
+    by_le: Dict[float, float] = {}
+    for labels, v in series.get(name + "_bucket", []):
+        le = labels.get("le", "")
+        bound = float("inf") if le in ("+Inf", "inf") else float(le)
+        by_le[bound] = by_le.get(bound, 0.0) + v
+    if not by_le:
+        return None
+    total = by_le.get(float("inf"), max(by_le.values()))
+    if total <= 0:
+        return None
+    target = q * total
+    for bound in sorted(by_le):
+        if by_le[bound] >= target:
+            return bound
+    return float("inf")
 
 
 # ---------------------------------------------------------------------------
@@ -215,6 +247,57 @@ def driver_replication(series: Dict[str, list],
     }
 
 
+def step_health(series: Dict[str, list]) -> dict:
+    """Step health / SLO (ISSUE 20): cluster step-time percentiles from
+    the merged ``hvd_tpu_step_seconds`` histogram, the anomaly
+    inventory by class and rank, flight dumps by trigger, and per-rank
+    HBM headroom."""
+    count = _total(series, "hvd_tpu_step_seconds_count")
+    ssum = _total(series, "hvd_tpu_step_seconds_sum")
+    p50 = histogram_quantile(series, "hvd_tpu_step_seconds", 0.50)
+    p99 = histogram_quantile(series, "hvd_tpu_step_seconds", 0.99)
+    anomalies_by_rank: Dict[str, Dict[str, float]] = {}
+    for labels, v in series.get("hvd_tpu_step_anomalies_total", []):
+        rank = labels.get("rank", "")
+        cls = labels.get("class", "")
+        anomalies_by_rank.setdefault(rank, {})
+        anomalies_by_rank[rank][cls] = \
+            anomalies_by_rank[rank].get(cls, 0.0) + v
+    hbm: Dict[str, dict] = {}
+    for labels, v in series.get("hvd_tpu_hbm_bytes", []):
+        rank = labels.get("rank", "")
+        hbm.setdefault(rank, {})[labels.get("kind", "")] = v
+    headroom = {}
+    for rank, kinds in hbm.items():
+        limit, in_use = kinds.get("limit"), kinds.get("in_use")
+        if limit and in_use is not None:
+            headroom[rank] = limit - in_use
+    return {
+        "steps_observed": count,
+        "step_time_mean_ms": (
+            round(1e3 * ssum / count, 3) if count else None),
+        "step_time_p50_ms": (
+            round(1e3 * p50, 3) if p50 not in (None, float("inf"))
+            else None),
+        "step_time_p99_ms": (
+            round(1e3 * p99, 3) if p99 not in (None, float("inf"))
+            else None),
+        "anomalies_total": _total(
+            series, "hvd_tpu_step_anomalies_total"),
+        "anomalies_by_class": _by_label(
+            series, "hvd_tpu_step_anomalies_total", "class"),
+        "anomalies_by_rank": anomalies_by_rank,
+        "flight_dumps": {
+            "total": _total(series, "hvd_tpu_flight_dumps_total"),
+            "by_trigger": _by_label(
+                series, "hvd_tpu_flight_dumps_total", "trigger")},
+        "hbm_bytes": hbm,
+        "hbm_headroom_bytes": headroom,
+        "hbm_min_headroom_bytes": (
+            min(headroom.values()) if headroom else None),
+    }
+
+
 def assemble(url: str, timeout: float = 10.0) -> dict:
     """Fetch all three endpoints and assemble the report dict. Each
     endpoint degrades independently — a root without the /agg route (flat
@@ -251,6 +334,7 @@ def assemble(url: str, timeout: float = 10.0) -> dict:
     report["control_plane"] = control_plane_load(series, agg_summary)
     report["driver_replication"] = driver_replication(
         series, repl_status, journal_head)
+    report["step_health"] = step_health(series)
     try:
         from horovod_tpu.trace import load_trace_events
         from tools.trace_report import arrival_skew, straggler_ranking
@@ -264,6 +348,57 @@ def assemble(url: str, timeout: float = 10.0) -> dict:
         report["errors"]["trace"] = str(e)
         report["stragglers"] = []
     return report
+
+
+def evaluate(report: dict, stale_after: float = 120.0) -> dict:
+    """Red/green the assembled report per section, in the
+    ``tools/check.py`` shape: ``{"ok", "checks": {section: {"ok",
+    "errors", "stats"}}}``. Green everywhere is the steady healthy
+    state; every red line names the evidence."""
+    checks: Dict[str, dict] = {}
+
+    def add(name: str, errors: List[str], stats: dict):
+        checks[name] = {"ok": not errors, "errors": errors, "stats": stats}
+
+    errs = []
+    if "metrics" in report.get("errors", {}):
+        errs.append("metrics endpoint unavailable: "
+                    f"{report['errors']['metrics']}")
+    add("endpoints", errs, {"errors": report.get("errors", {})})
+
+    errs = []
+    for k, ent in report.get("slices", {}).items():
+        for stream, age in ent.get("rollup_age", {}).items():
+            if age is not None and age > stale_after:
+                errs.append(f"slice {k} {stream} rollup is {age:.0f}s "
+                            f"stale (> {stale_after:.0f}s)")
+    add("slices", errs, {"slices": len(report.get("slices", {}))})
+
+    deg = report.get("degradation", {})
+    errs = []
+    for key, label in (("kv_acked_writes_lost", "acked KV writes lost"),
+                       ("kv_gave_up", "KV publishes gave up"),
+                       ("watchdog_escalations", "watchdog escalations")):
+        if deg.get(key, 0):
+            errs.append(f"{label}: {deg[key]:.0f}")
+    add("degradation", errs, deg)
+
+    sh = report.get("step_health", {})
+    errs = []
+    if sh.get("anomalies_total", 0):
+        by_cls = ", ".join(f"{c}={v:.0f}" for c, v in
+                           sorted(sh.get("anomalies_by_class", {}).items()))
+        errs.append(f"{sh['anomalies_total']:.0f} step anomalies "
+                    f"({by_cls})")
+    for rank, hr in sorted(sh.get("hbm_headroom_bytes", {}).items()):
+        if hr < 0:
+            errs.append(f"rank {rank} HBM over limit by {-hr:.0f} bytes")
+    add("step_health", errs, sh)
+
+    add("control_plane", [], report.get("control_plane", {}))
+    add("driver_replication", [], report.get("driver_replication", {}))
+
+    return {"ok": all(c["ok"] for c in checks.values()), "checks": checks}
 
 
 # ---------------------------------------------------------------------------
@@ -361,6 +496,50 @@ def render(report: dict) -> str:
         f"failovers: {dr.get('failovers', 0):.0f}  "
         f"failover recoveries: {dr.get('failover_recoveries', 0):.0f}  "
         f"discovery failures: {dr.get('discovery_failures', 0):.0f}")
+    sh = report.get("step_health", {})
+    lines.append("")
+    lines.append("step health / SLO:")
+    if sh.get("steps_observed"):
+        def _ms(v):
+            return "?" if v is None else f"{v:.1f} ms"
+        lines.append(
+            f"  step time: p50 {_ms(sh.get('step_time_p50_ms'))}  "
+            f"p99 {_ms(sh.get('step_time_p99_ms'))}  "
+            f"mean {_ms(sh.get('step_time_mean_ms'))}  "
+            f"({sh['steps_observed']:.0f} steps observed)")
+    else:
+        lines.append("  step time: no hvd_tpu_step_seconds samples yet "
+                     "(HOROVOD_TPU_STEP_HEALTH=0, or no steps bracketed)")
+    anom = sh.get("anomalies_total", 0)
+    if anom:
+        by_cls = "  ".join(
+            f"{c}={v:.0f}" for c, v in
+            sorted(sh.get("anomalies_by_class", {}).items()))
+        lines.append(f"  anomalies: {anom:.0f}  ({by_cls})")
+        for rank, classes in sorted(sh.get("anomalies_by_rank",
+                                           {}).items()):
+            row = "  ".join(f"{c}={v:.0f}"
+                            for c, v in sorted(classes.items()))
+            lines.append(f"    rank {rank:<4} {row}")
+    else:
+        lines.append("  anomalies: none")
+    dumps = sh.get("flight_dumps", {})
+    if dumps.get("total"):
+        by_trig = "  ".join(
+            f"{t}={v:.0f}" for t, v in
+            sorted(dumps.get("by_trigger", {}).items()))
+        lines.append(f"  flight dumps: {dumps['total']:.0f}  ({by_trig})")
+    headroom = sh.get("hbm_headroom_bytes", {})
+    if headroom:
+        for rank, hr in sorted(headroom.items()):
+            kinds = sh.get("hbm_bytes", {}).get(rank, {})
+            lines.append(
+                f"  rank {rank:<4} HBM headroom {hr / 2**30:.2f} GiB "
+                f"(in use {kinds.get('in_use', 0) / 2**30:.2f} / "
+                f"limit {kinds.get('limit', 0) / 2**30:.2f} GiB)")
+    else:
+        lines.append("  hbm: no device memory stats published "
+                     "(CPU rig, or HOROVOD_TPU_HBM=0)")
     return "\n".join(lines)
 
 
@@ -374,14 +553,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--timeout", type=float, default=10.0,
                    help="per-endpoint fetch timeout (seconds)")
     p.add_argument("--json", action="store_true",
-                   help="emit the report as JSON")
+                   help="emit the raw assembled report as JSON")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="'json' emits the evaluated report in the "
+                        "tools/check.py shape ({ok, checks}); the exit "
+                        "code is nonzero when any section is red")
     args = p.parse_args(argv)
     report = assemble(args.url, timeout=args.timeout)
-    if args.json:
+    verdict = evaluate(report)
+    if args.format == "json":
+        print(json.dumps(verdict, indent=2, sort_keys=True))
+    elif args.json:
         print(json.dumps(report, indent=2, sort_keys=True))
     else:
         print(render(report))
-    return 0
+        if not verdict["ok"]:
+            red = [name for name, c in sorted(verdict["checks"].items())
+                   if not c["ok"]]
+            print(f"\nRED sections: {', '.join(red)}")
+    return 0 if verdict["ok"] else 1
 
 
 if __name__ == "__main__":

@@ -487,6 +487,46 @@ def schedule_timeline(schedule: str, n_stages: int, n_micro: int,
     return "\n".join(lines)
 
 
+def anomaly_report(events: List[dict],
+                   meta: Optional[dict] = None) -> dict:
+    """Cross-reference an anomaly's flight dump with ``gap_attribution``
+    (ISSUE 20): for each rank in the dump, name the culprit phase — the
+    dominant sink (compute / dispatch / wire / straggler_wait) of the
+    step time the trace ring captured around the anomaly — plus the
+    arrival-skew straggler ranking over the same window."""
+    skews = arrival_skew(events)
+    attr = gap_attribution(events, skews)
+    culprits = {}
+    for pid, g in attr.items():
+        pct = g.get("pct", {})
+        if not pct:
+            continue
+        phase = max(pct, key=lambda k: pct[k])
+        culprits[pid] = {"phase": phase, "pct": pct[phase],
+                         "per_step_total_us": g.get("per_step_total_us")}
+    return {
+        "meta": meta or {},
+        "events": len(events),
+        "culprit_phase": culprits,
+        "stragglers": straggler_ranking(skews)[:5],
+        "gap_attribution": attr,
+    }
+
+
+def _load_dump_meta(path: str) -> dict:
+    """The flight dump's ``otherData`` block (rank, dropped-event count,
+    flight_recorder marker) — tolerant of array-form/truncated files."""
+    import json as _json
+    try:
+        with open(path) as f:
+            obj = _json.load(f)
+        if isinstance(obj, dict):
+            return obj.get("otherData", {}) or {}
+    except Exception:
+        pass
+    return {}
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
     p = argparse.ArgumentParser(
@@ -513,15 +553,49 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="microbatches for --schedule-timeline")
     p.add_argument("--virtual", type=int, default=1,
                    help="virtual chunks per stage for --schedule-timeline")
+    p.add_argument("--anomaly", metavar="DUMP",
+                   help="cross-reference an anomaly's flight dump "
+                        "(hvd_tpu_flight_rank<r>.json) with "
+                        "gap_attribution: name the culprit phase of the "
+                        "step window the trace ring captured")
     args = p.parse_args(argv)
 
     if args.schedule_timeline:
         print(schedule_timeline(args.schedule_timeline, args.stages,
                                 args.micro, args.virtual))
         return 0
+    if args.anomaly:
+        from horovod_tpu.trace import load_trace_file
+        events = load_trace_file(args.anomaly)
+        rep = anomaly_report(events, _load_dump_meta(args.anomaly))
+        if args.json:
+            print(json.dumps(rep, indent=2, sort_keys=True))
+            return 0
+        meta = rep["meta"]
+        print(f"anomaly flight dump: {args.anomaly}")
+        print(f"  rank={meta.get('rank', '?')}  "
+              f"events={rep['events']}  "
+              f"dropped={meta.get('dropped', 0)}  "
+              f"flight_recorder={meta.get('flight_recorder', False)}")
+        if rep["culprit_phase"]:
+            print("\nculprit phase per rank (dominant step-time sink in "
+                  "the captured window):")
+            for pid, c in sorted(rep["culprit_phase"].items()):
+                print(f"  rank {pid:<4} {c['phase']:<16} "
+                      f"{c['pct']:5.1f}% of step "
+                      f"(per-step {_fmt_us(c['per_step_total_us'])})")
+        else:
+            print("\nno step windows in the dump — nothing to attribute")
+        if rep["stragglers"]:
+            print("\nstragglers in the captured window:")
+            for acc in rep["stragglers"][:args.top]:
+                print(f"  rank {acc['rank']:<4} last-arrival "
+                      f"{acc['last_count']:>4}x   mean lateness "
+                      f"{_fmt_us(acc['mean_late_us'])}")
+        return 0
     if args.trace is None:
         p.error("a trace file is required unless --schedule-timeline "
-                "is given")
+                "or --anomaly is given")
 
     from horovod_tpu.trace import load_trace_file
     events = load_trace_file(args.trace)
